@@ -1,0 +1,30 @@
+"""sparkdl_tpu — a TPU-native deep-learning-pipelines framework.
+
+A ground-up re-design of the capability surface of Databricks' Deep Learning
+Pipelines (``sparkdl``, reference fork ``smurching/spark-deep-learning``) for
+TPU: the Spark-ML-shaped Pipeline API (``fit``/``transform``, Params,
+persistence) over an Arrow columnar data plane, with inference as
+``jax.jit``-compiled XLA programs fed by a double-buffered HBM pipeline, and
+distributed training via ``XlaRunner`` — SPMD over a ``jax.sharding.Mesh``
+with ICI collectives — replacing the reference's Horovod MPI+NCCL stack.
+
+See SURVEY.md for the blueprint (the reference mount was empty at build time;
+the survey + BASELINE.json are the spec).
+"""
+
+__version__ = "0.1.0"
+
+from .core import (DataFrame, Estimator, Evaluator, HasBatchSize, HasInputCol,
+                   HasLabelCol, HasOutputCol, HasPredictionCol, HasSeed,
+                   MLWritable, Model, Param, Params, Pipeline, PipelineModel,
+                   Row, Transformer, TypeConverters, keyword_only, load)
+
+__all__ = [
+    "DataFrame", "Row",
+    "Param", "Params", "TypeConverters", "keyword_only",
+    "HasInputCol", "HasOutputCol", "HasLabelCol", "HasPredictionCol",
+    "HasBatchSize", "HasSeed",
+    "Transformer", "Estimator", "Model", "Evaluator",
+    "Pipeline", "PipelineModel", "MLWritable", "load",
+    "__version__",
+]

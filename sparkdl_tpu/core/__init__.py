@@ -1,0 +1,15 @@
+from .params import (Param, Params, TypeConverters, keyword_only,
+                     HasInputCol, HasOutputCol, HasLabelCol, HasPredictionCol,
+                     HasBatchSize, HasSeed)
+from .pipeline import (Transformer, Estimator, Model, Evaluator,
+                       Pipeline, PipelineModel, MLWritable, load)
+from .frame import DataFrame, Row
+
+__all__ = [
+    "Param", "Params", "TypeConverters", "keyword_only",
+    "HasInputCol", "HasOutputCol", "HasLabelCol", "HasPredictionCol",
+    "HasBatchSize", "HasSeed",
+    "Transformer", "Estimator", "Model", "Evaluator",
+    "Pipeline", "PipelineModel", "MLWritable", "load",
+    "DataFrame", "Row",
+]

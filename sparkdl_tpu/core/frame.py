@@ -1,0 +1,299 @@
+"""Arrow-native columnar DataFrame — the data plane of the framework.
+
+The reference rode on the Spark JVM DataFrame for its data plane and on
+TensorFrames' JNI bridge to move partition batches into the TF C++ runtime
+(SURVEY.md §1 L0, §2.3). Neither a JVM nor pyspark exists here, and neither is
+the right substrate for TPU: what the TPU wants is *large contiguous host
+buffers handed to ``jax.device_put``*. So the data plane is pyarrow
+RecordBatches, partitioned, with a lazy per-batch op chain — ``mapBatches`` is
+the ``mapPartitions`` analogue and the single primitive every transformer
+lowers to.
+
+Laziness model: narrow ops (select/withColumn/filter/mapBatches) append to an
+op chain and are applied per-partition on materialization; this keeps a chain
+of transformers single-pass over the data (decode → preprocess → featurize
+without intermediate materialization), which is what feeds the HBM pipeline in
+:mod:`sparkdl_tpu.core.runtime`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, Sequence
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+
+class Row(dict):
+    """Dict with attribute access, mirroring pyspark.sql.Row ergonomics."""
+
+    def __getattr__(self, name):
+        try:
+            return self[name]
+        except KeyError as e:
+            raise AttributeError(name) from e
+
+    def asDict(self):
+        return dict(self)
+
+
+def _to_arrow_array(values, length: int) -> pa.Array:
+    if isinstance(values, (pa.Array, pa.ChunkedArray)):
+        arr = values.combine_chunks() if isinstance(values, pa.ChunkedArray) else values
+    elif isinstance(values, np.ndarray):
+        if values.ndim == 1:
+            arr = pa.array(values)
+        else:
+            # N-d numpy → nested lists so tensor columns keep their shape.
+            arr = pa.array(values.tolist())
+    else:
+        arr = pa.array(list(values))
+    if len(arr) != length:
+        raise ValueError(f"Column length {len(arr)} != batch length {length}")
+    return arr
+
+
+class DataFrame:
+    """A partitioned, lazily-transformed collection of Arrow RecordBatches."""
+
+    def __init__(self, partitions: Sequence[pa.RecordBatch],
+                 ops: tuple[Callable[[pa.RecordBatch], pa.RecordBatch], ...] = ()):
+        self._partitions = list(partitions)
+        self._ops = tuple(ops)
+
+    # -- constructors ------------------------------------------------------
+    @classmethod
+    def fromPandas(cls, df: pd.DataFrame, numPartitions: int = 1) -> "DataFrame":
+        table = pa.Table.from_pandas(df, preserve_index=False)
+        return cls.fromArrow(table, numPartitions)
+
+    @classmethod
+    def fromArrow(cls, table: pa.Table, numPartitions: int = 1) -> "DataFrame":
+        n = max(1, len(table))
+        numPartitions = max(1, min(numPartitions, n))
+        per = -(-n // numPartitions)
+        parts = []
+        for start in range(0, n, per):
+            chunk = table.slice(start, per).combine_chunks()
+            parts.append(chunk.to_batches(max_chunksize=per)[0] if len(chunk)
+                         else pa.RecordBatch.from_pydict(
+                             {c: [] for c in table.column_names}))
+        return cls(parts)
+
+    @classmethod
+    def fromPydict(cls, data: dict[str, Any], numPartitions: int = 1) -> "DataFrame":
+        cols = {}
+        for k, v in data.items():
+            if isinstance(v, np.ndarray) and v.ndim > 1:
+                cols[k] = pa.array(v.tolist())
+            else:
+                cols[k] = pa.array(v) if not isinstance(v, pa.Array) else v
+        return cls.fromArrow(pa.table(cols), numPartitions)
+
+    @classmethod
+    def fromRows(cls, rows: Sequence[dict], numPartitions: int = 1) -> "DataFrame":
+        if not rows:
+            raise ValueError("fromRows needs at least one row")
+        keys = list(rows[0].keys())
+        return cls.fromPydict({k: [r[k] for r in rows] for k in keys},
+                              numPartitions)
+
+    # -- schema ------------------------------------------------------------
+    @property
+    def schema(self) -> pa.Schema:
+        if not self._partitions:
+            return pa.schema([])
+        probe = self._apply_ops(self._partitions[0].slice(0, min(
+            1, self._partitions[0].num_rows)))
+        return probe.schema
+
+    @property
+    def columns(self) -> list[str]:
+        return list(self.schema.names)
+
+    # -- lazy narrow ops ---------------------------------------------------
+    def mapBatches(self, fn: Callable[[pa.RecordBatch], pa.RecordBatch]) -> "DataFrame":
+        """The mapPartitions analogue — everything lowers to this."""
+        return DataFrame(self._partitions, self._ops + (fn,))
+
+    def select(self, *cols: str) -> "DataFrame":
+        names = list(cols)
+        return self.mapBatches(_length_preserving(lambda b: b.select(names)))
+
+    def drop(self, *cols: str) -> "DataFrame":
+        dropped = set(cols)
+
+        def op(b: pa.RecordBatch) -> pa.RecordBatch:
+            keep = [c for c in b.schema.names if c not in dropped]
+            return b.select(keep)
+
+        return self.mapBatches(_length_preserving(op))
+
+    def withColumn(self, name: str, fn: Callable[..., Any],
+                   inputCols: Sequence[str] | None = None) -> "DataFrame":
+        """Row-wise column: fn(*row_values) per row. Convenience path — hot
+        paths should use withColumnBatch."""
+        in_cols = list(inputCols) if inputCols else None
+
+        def op(b: pa.RecordBatch) -> pa.RecordBatch:
+            srcs = in_cols if in_cols is not None else b.schema.names
+            pylists = [b.column(c).to_pylist() for c in srcs]
+            out = [fn(*vals) for vals in zip(*pylists)] if pylists else []
+            return _set_column(b, name, pa.array(out))
+
+        return self.mapBatches(_length_preserving(op))
+
+    def withColumnBatch(self, name: str, fn: Callable[..., Any],
+                        inputCols: Sequence[str]) -> "DataFrame":
+        """Vectorized column: fn(*arrow_arrays) → array-like of batch length."""
+        in_cols = list(inputCols)
+
+        def op(b: pa.RecordBatch) -> pa.RecordBatch:
+            out = fn(*[b.column(c) for c in in_cols])
+            return _set_column(b, name, _to_arrow_array(out, b.num_rows))
+
+        return self.mapBatches(_length_preserving(op))
+
+    def withColumnRenamed(self, old: str, new: str) -> "DataFrame":
+        def op(b: pa.RecordBatch) -> pa.RecordBatch:
+            names = [new if c == old else c for c in b.schema.names]
+            return pa.RecordBatch.from_arrays(list(b.columns), names=names)
+
+        return self.mapBatches(_length_preserving(op))
+
+    def filter(self, predicate: Callable[[Row], bool]) -> "DataFrame":
+        def op(b: pa.RecordBatch) -> pa.RecordBatch:
+            mask = pa.array([bool(predicate(Row(r)))
+                             for r in b.to_pylist()], type=pa.bool_())
+            return b.filter(mask)
+
+        op._changes_length = True
+        return self.mapBatches(op)
+
+    # -- materialization ---------------------------------------------------
+    def _apply_ops(self, batch: pa.RecordBatch) -> pa.RecordBatch:
+        for op in self._ops:
+            batch = op(batch)
+        return batch
+
+    def iterPartitions(self) -> Iterator[pa.RecordBatch]:
+        for p in self._partitions:
+            yield self._apply_ops(p)
+
+    def iterBatches(self, batchSize: int) -> Iterator[pa.RecordBatch]:
+        """Re-chunked stream of materialized batches — the feeder input.
+
+        Partition boundaries are erased: output batches are exactly
+        ``batchSize`` rows except possibly the last, which is what a static-
+        shape XLA program wants (pad-and-mask handled downstream)."""
+        carry: pa.Table | None = None
+        for part in self.iterPartitions():
+            t = pa.Table.from_batches([part]) if part.num_rows else None
+            if t is None:
+                continue
+            carry = t if carry is None else pa.concat_tables([carry, t])
+            while carry.num_rows >= batchSize:
+                head = carry.slice(0, batchSize).combine_chunks()
+                yield head.to_batches(max_chunksize=batchSize)[0]
+                carry = carry.slice(batchSize)
+        if carry is not None and carry.num_rows:
+            rest = carry.combine_chunks()
+            yield rest.to_batches(max_chunksize=rest.num_rows)[0]
+
+    def cache(self) -> "DataFrame":
+        """Materialize the op chain now (eager) — analogous to df.cache()."""
+        return DataFrame(list(self.iterPartitions()))
+
+    def repartition(self, numPartitions: int) -> "DataFrame":
+        return DataFrame.fromArrow(self.toArrow(), numPartitions)
+
+    @property
+    def numPartitions(self) -> int:
+        return len(self._partitions)
+
+    def toArrow(self) -> pa.Table:
+        batches = [b for b in self.iterPartitions()]
+        if not batches:
+            return pa.table({})
+        return pa.Table.from_batches(batches)
+
+    def toPandas(self) -> pd.DataFrame:
+        return self.toArrow().to_pandas()
+
+    def collect(self) -> list[Row]:
+        return [Row(r) for r in self.toArrow().to_pylist()]
+
+    def take(self, n: int) -> list[Row]:
+        out: list[Row] = []
+        for part in self.iterPartitions():
+            for r in part.slice(0, n - len(out)).to_pylist():
+                out.append(Row(r))
+            if len(out) >= n:
+                break
+        return out
+
+    def first(self) -> Row:
+        rows = self.take(1)
+        if not rows:
+            raise ValueError("DataFrame is empty")
+        return rows[0]
+
+    def limit(self, n: int) -> "DataFrame":
+        if not any(_op_changes_length(o) for o in self._ops):
+            # Fast path: ops preserve row count, so slicing raw partitions is
+            # exactly equivalent and stays lazy.
+            rows_remaining = n
+            parts = []
+            for p in self._partitions:
+                if rows_remaining <= 0:
+                    break
+                take = min(rows_remaining, p.num_rows)
+                parts.append(p.slice(0, take))
+                rows_remaining -= take
+            return DataFrame(parts, self._ops)
+        # Length-changing ops (filter) must run before the limit applies.
+        rows_remaining = n
+        parts = []
+        for part in self.iterPartitions():
+            if rows_remaining <= 0:
+                break
+            take = min(rows_remaining, part.num_rows)
+            parts.append(part.slice(0, take))
+            rows_remaining -= take
+        return DataFrame(parts)
+
+    def count(self) -> int:
+        if not any(_op_changes_length(o) for o in self._ops):
+            return sum(p.num_rows for p in self._partitions)
+        return sum(b.num_rows for b in self.iterPartitions())
+
+    def __repr__(self) -> str:
+        try:
+            cols = ", ".join(f"{f.name}:{f.type}" for f in self.schema)
+        except Exception:
+            cols = "?"
+        return (f"DataFrame[{cols}] "
+                f"({self.numPartitions} partition(s), {len(self._ops)} pending op(s))")
+
+
+def _op_changes_length(op) -> bool:
+    # Ops built by filter() are tagged; user mapBatches fns are untagged and
+    # conservatively treated as length-changing (they may re-chunk or drop).
+    return getattr(op, "_changes_length", None) is not False
+
+
+def _length_preserving(op):
+    op._changes_length = False
+    return op
+
+
+def _set_column(batch: pa.RecordBatch, name: str, array: pa.Array) -> pa.RecordBatch:
+    names = list(batch.schema.names)
+    arrays = list(batch.columns)
+    if name in names:
+        arrays[names.index(name)] = array
+    else:
+        names.append(name)
+        arrays.append(array)
+    return pa.RecordBatch.from_arrays(arrays, names=names)

@@ -1,0 +1,28 @@
+"""Test bootstrap: force JAX onto a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is not available in CI/dev; collective semantics
+(psum over ICI, shard_map sharding rules) are validated on XLA's host platform
+with 8 virtual devices, exactly as the driver's multichip dryrun does.
+
+Note: the axon sitecustomize pre-imports jax in every interpreter, so plain
+env-var JAX_PLATFORMS is already latched — we must go through jax.config
+before the backend initializes (conftest runs before any test imports).
+"""
+
+import os
+import sys
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+assert len(jax.devices()) == 8, (
+    f"expected 8 virtual CPU devices for sharding tests, got {jax.devices()}")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,126 @@
+"""DataFrame (Arrow data plane) tests — mapBatches is the load-bearing primitive."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from sparkdl_tpu.core.frame import DataFrame
+
+
+def make_df(n=10, parts=3):
+    return DataFrame.fromPydict(
+        {"x": list(range(n)), "y": [float(i) * 2 for i in range(n)]},
+        numPartitions=parts)
+
+
+def test_constructors_roundtrip():
+    df = make_df()
+    assert df.count() == 10
+    assert df.numPartitions == 3
+    assert df.columns == ["x", "y"]
+    pdf = df.toPandas()
+    assert list(pdf["x"]) == list(range(10))
+
+    df2 = DataFrame.fromPandas(pd.DataFrame({"a": [1, 2, 3]}), numPartitions=2)
+    assert df2.count() == 3 and df2.numPartitions == 2
+
+    df3 = DataFrame.fromRows([{"a": 1}, {"a": 2}])
+    assert [r.a for r in df3.collect()] == [1, 2]
+
+
+def test_select_drop_rename():
+    df = make_df()
+    assert df.select("y").columns == ["y"]
+    assert df.drop("y").columns == ["x"]
+    assert df.withColumnRenamed("x", "z").columns == ["z", "y"]
+
+
+def test_with_column_rowwise_and_batch():
+    df = make_df(6, parts=2)
+    out = df.withColumn("s", lambda x, y: x + y, inputCols=["x", "y"])
+    rows = out.collect()
+    assert all(r.s == r.x + r.y for r in rows)
+
+    out2 = df.withColumnBatch(
+        "z", lambda x: np.asarray(x) * 10, inputCols=["x"])
+    assert [r.z for r in out2.collect()] == [i * 10 for i in range(6)]
+
+
+def test_filter_and_count():
+    df = make_df(10, parts=4)
+    f = df.filter(lambda r: r.x % 2 == 0)
+    assert f.count() == 5
+    assert all(r.x % 2 == 0 for r in f.collect())
+
+
+def test_iter_batches_rechunks_across_partitions():
+    df = make_df(10, parts=3)  # partitions of 4,4,2
+    sizes = [b.num_rows for b in df.iterBatches(3)]
+    assert sizes == [3, 3, 3, 1]
+    seen = []
+    for b in df.iterBatches(4):
+        seen.extend(b.column("x").to_pylist())
+    assert seen == list(range(10))
+
+
+def test_lazy_ops_compose_single_pass():
+    calls = []
+    df = make_df(4, parts=1)
+
+    def op(b):
+        calls.append(b.num_rows)
+        return b
+
+    chained = df.mapBatches(op).select("x")
+    assert calls == []  # nothing ran yet
+    chained.collect()
+    assert calls == [4]
+
+
+def test_nested_tensor_column():
+    imgs = np.arange(2 * 2 * 3, dtype=np.float32).reshape(2, 2, 3)
+    df = DataFrame.fromPydict({"img": imgs, "label": [0, 1]})
+    rows = df.collect()
+    assert np.allclose(np.asarray(rows[0].img), imgs[0])
+
+
+def test_take_limit_first_cache_repartition():
+    df = make_df(10, parts=3)
+    assert [r.x for r in df.take(5)] == [0, 1, 2, 3, 4]
+    assert df.limit(5).count() == 5
+    assert df.first().x == 0
+    cached = df.withColumn("z", lambda x: x + 1, inputCols=["x"]).cache()
+    assert cached._ops == ()
+    assert cached.count() == 10
+    rp = df.repartition(5)
+    assert rp.numPartitions == 5 and rp.count() == 10
+    with pytest.raises(ValueError):
+        DataFrame.fromPydict({"x": []}).first()
+
+
+def test_limit_after_filter_applies_post_filter():
+    # Regression: limit must see the filtered stream, not raw partitions.
+    df = DataFrame.fromPydict({"x": list(range(10))}, numPartitions=3)
+    out = df.filter(lambda r: r.x % 2 == 0).limit(3)
+    assert [r.x for r in out.collect()] == [0, 2, 4]
+
+
+def test_with_column_batch_preserves_tensor_shape():
+    df = DataFrame.fromPydict({"x": list(range(4))})
+    out = df.withColumnBatch("t", lambda x: np.ones((4, 2, 3), np.float32),
+                             inputCols=["x"])
+    assert np.asarray(out.first().t).shape == (2, 3)
+
+
+def test_count_fast_path_does_not_materialize():
+    calls = []
+    df = make_df(6, parts=2)
+
+    def probe(x):
+        calls.append(1)
+        return np.asarray(x)
+
+    chained = df.select("x").withColumnBatch("y", probe, inputCols=["x"])
+    assert chained.count() == 6
+    assert calls == []  # length-preserving chain → no materialization

@@ -1,0 +1,93 @@
+"""Params system contract tests (SURVEY.md §5.6: must match Spark ML semantics)."""
+
+import pytest
+
+from sparkdl_tpu.core.params import (HasBatchSize, HasInputCol, HasOutputCol,
+                                     Param, Params, TypeConverters,
+                                     keyword_only)
+
+
+class Stage(HasInputCol, HasOutputCol, HasBatchSize):
+    threshold = Param(Params, "threshold", "a float knob", TypeConverters.toFloat)
+
+    @keyword_only
+    def __init__(self, inputCol=None, outputCol=None, batchSize=None,
+                 threshold=None):
+        super().__init__()
+        self._setDefault(batchSize=32, threshold=0.5)
+        self._set(**self._input_kwargs)
+
+    @keyword_only
+    def setParams(self, inputCol=None, outputCol=None, batchSize=None,
+                  threshold=None):
+        return self._set(**self._input_kwargs)
+
+
+def test_defaults_and_set():
+    s = Stage(inputCol="image")
+    assert s.getInputCol() == "image"
+    assert s.getBatchSize() == 32
+    assert s.getOrDefault("threshold") == 0.5
+    s.setParams(threshold=0.9, outputCol="features")
+    assert s.getOrDefault(s.threshold) == 0.9
+    assert s.getOutputCol() == "features"
+    assert s.isSet(s.threshold) and not s.isSet(s.batchSize)
+    assert s.isDefined(s.batchSize) and s.hasDefault("batchSize")
+
+
+def test_type_converters_validate_eagerly():
+    s = Stage()
+    s.set("threshold", 1)  # int → float coercion
+    assert isinstance(s.getOrDefault("threshold"), float)
+    with pytest.raises(TypeError):
+        s.set("threshold", "hot")
+    with pytest.raises(TypeError):
+        s.set("batchSize", 3.5)
+    with pytest.raises(TypeError):
+        TypeConverters.toShape([4, -1])
+    assert TypeConverters.toShape([4, 224, 224, 3]) == (4, 224, 224, 3)
+    with pytest.raises(TypeError):
+        TypeConverters.toInt(True)
+
+
+def test_keyword_only_rejects_positional():
+    with pytest.raises(TypeError):
+        Stage("image")
+
+
+def test_copy_preserves_uid_and_isolates_maps():
+    s = Stage(inputCol="a", threshold=0.7)
+    c = s.copy({s.threshold: 0.1})
+    assert c.uid == s.uid
+    assert c.getOrDefault("threshold") == 0.1
+    assert s.getOrDefault("threshold") == 0.7
+    c.set("inputCol", "b")
+    assert s.getInputCol() == "a"
+
+
+def test_params_listing_and_explain():
+    s = Stage(inputCol="x")
+    names = [p.name for p in s.params]
+    assert names == sorted(names)
+    assert {"inputCol", "outputCol", "batchSize", "threshold"} <= set(names)
+    text = s.explainParams()
+    assert "threshold" in text and "default: 0.5" in text
+    assert "current: x" in s.explainParam("inputCol")
+
+
+def test_extract_param_map_with_extra():
+    s = Stage(inputCol="a")
+    m = s.extractParamMap({s.threshold: 0.3})
+    assert m[s.threshold] == 0.3
+    assert m[s.inputCol] == "a"
+    assert m[s.batchSize] == 32
+
+
+def test_foreign_param_rejected():
+    s1, s2 = Stage(), Stage()
+    with pytest.raises(ValueError):
+        s1.set(s2.threshold, 0.2)
+
+
+def test_param_uids_unique():
+    assert Stage().uid != Stage().uid

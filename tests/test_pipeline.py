@@ -1,0 +1,182 @@
+"""Pipeline/Transformer/Estimator contract + persistence tests."""
+
+import numpy as np
+
+from sparkdl_tpu.core.frame import DataFrame
+from sparkdl_tpu.core.params import (HasInputCol, HasOutputCol, Param, Params,
+                                     TypeConverters, keyword_only)
+from sparkdl_tpu.core.pipeline import (Estimator, MLWritable, Model, Pipeline,
+                                       PipelineModel, Transformer)
+
+
+class AddConst(Transformer, HasInputCol, HasOutputCol):
+    amount = Param(Params, "amount", "value to add", TypeConverters.toFloat)
+
+    @keyword_only
+    def __init__(self, inputCol=None, outputCol=None, amount=None):
+        super().__init__()
+        self._setDefault(amount=1.0)
+        self._set(**self._input_kwargs)
+
+    def _transform(self, dataset):
+        a = self.getOrDefault(self.amount)
+        return dataset.withColumnBatch(
+            self.getOutputCol(), lambda x: np.asarray(x, dtype=np.float64) + a,
+            inputCols=[self.getInputCol()])
+
+
+class MeanModel(Model, HasInputCol, HasOutputCol):
+    def __init__(self, mean=0.0, inputCol=None, outputCol=None):
+        super().__init__()
+        self.mean = mean
+        self._set(inputCol=inputCol, outputCol=outputCol)
+
+    def _transform(self, dataset):
+        return dataset.withColumnBatch(
+            self.getOutputCol(),
+            lambda x: np.asarray(x, dtype=np.float64) - self.mean,
+            inputCols=[self.getInputCol()])
+
+    def _save_payload(self, path):
+        import json, os
+        with open(os.path.join(path, "payload.json"), "w") as f:
+            json.dump({"mean": self.mean}, f)
+
+    def _load_payload(self, path, meta):
+        import json, os
+        with open(os.path.join(path, "payload.json")) as f:
+            self.mean = json.load(f)["mean"]
+
+
+class Center(Estimator, HasInputCol, HasOutputCol):
+    @keyword_only
+    def __init__(self, inputCol=None, outputCol=None):
+        super().__init__()
+        self._set(**self._input_kwargs)
+
+    def _fit(self, dataset):
+        vals = np.asarray([r[self.getInputCol()] for r in dataset.collect()])
+        return MeanModel(float(vals.mean()), self.getInputCol(),
+                         self.getOutputCol())
+
+
+def data():
+    return DataFrame.fromPydict({"v": [1.0, 2.0, 3.0, 4.0]}, numPartitions=2)
+
+
+def test_transform_with_param_override():
+    t = AddConst(inputCol="v", outputCol="o", amount=2.0)
+    out = t.transform(data())
+    assert [r.o for r in out.collect()] == [3.0, 4.0, 5.0, 6.0]
+    out2 = t.transform(data(), {t.amount: 10.0})
+    assert [r.o for r in out2.collect()] == [11.0, 12.0, 13.0, 14.0]
+    assert t.getOrDefault("amount") == 2.0  # original untouched
+
+
+def test_estimator_fit_and_fit_multiple():
+    est = Center(inputCol="v", outputCol="c")
+    model = est.fit(data())
+    assert model.mean == 2.5
+    out = model.transform(data())
+    assert [r.c for r in out.collect()] == [-1.5, -0.5, 0.5, 1.5]
+
+    t = AddConst(inputCol="v", outputCol="o")
+    maps = [{t.amount: 1.0}, {t.amount: 2.0}]
+
+    class AmountEst(Estimator):
+        def __init__(self):
+            super().__init__()
+            self.amount = Param(self, "amount", "", TypeConverters.toFloat)
+            self._setDefault(amount=0.0)
+
+        def _fit(self, dataset):
+            return MeanModel(self.getOrDefault("amount"), "v", "o")
+
+    e = AmountEst()
+    results = dict(e.fitMultiple(data(), [{e.amount: 5.0}, {e.amount: 7.0}]))
+    assert results[0].mean == 5.0 and results[1].mean == 7.0
+    models = e.fit(data(), [{e.amount: 1.0}, {e.amount: 2.0}])
+    assert sorted(m.mean for m in models) == [1.0, 2.0]
+
+
+def test_pipeline_fit_transform():
+    pipe = Pipeline(stages=[
+        AddConst(inputCol="v", outputCol="a", amount=1.0),
+        Center(inputCol="a", outputCol="c"),
+    ])
+    pm = pipe.fit(data())
+    assert isinstance(pm, PipelineModel)
+    out = pm.transform(data())
+    assert [r.c for r in out.collect()] == [-1.5, -0.5, 0.5, 1.5]
+
+
+def test_pipeline_model_persistence(tmp_path):
+    pipe = Pipeline(stages=[
+        AddConst(inputCol="v", outputCol="a", amount=1.0),
+        Center(inputCol="a", outputCol="c"),
+    ])
+    pm = pipe.fit(data())
+    p = str(tmp_path / "pm")
+    pm.save(p)
+    loaded = MLWritable.load(p)
+    assert isinstance(loaded, PipelineModel)
+    out = loaded.transform(data())
+    assert [r.c for r in out.collect()] == [-1.5, -0.5, 0.5, 1.5]
+    assert loaded.uid == pm.uid
+    assert loaded.stages[1].mean == 3.5
+
+
+def test_transformer_persistence_roundtrip(tmp_path):
+    t = AddConst(inputCol="v", outputCol="o", amount=4.0)
+    p = str(tmp_path / "t")
+    t.save(p)
+    loaded = MLWritable.load(p)
+    assert loaded.getOrDefault("amount") == 4.0
+    assert loaded.getInputCol() == "v"
+    out = loaded.transform(data())
+    assert [r.o for r in out.collect()] == [5.0, 6.0, 7.0, 8.0]
+
+
+def test_pipeline_estimator_persistence(tmp_path):
+    pipe = Pipeline(stages=[AddConst(inputCol="v", outputCol="a", amount=1.0)])
+    p = str(tmp_path / "pipe")
+    pipe.save(p)
+    loaded = MLWritable.load(p)
+    assert isinstance(loaded, Pipeline)
+    assert len(loaded.getStages()) == 1
+    pm = loaded.fit(data())
+    assert [r.a for r in pm.transform(data()).collect()] == [2.0, 3.0, 4.0, 5.0]
+
+
+def test_fit_empty_param_maps():
+    class E(Estimator):
+        def _fit(self, dataset):
+            return 1
+
+    assert E().fit(data(), []) == []
+
+
+def test_abstract_stages_not_instantiable():
+    import pytest
+    with pytest.raises(TypeError):
+        Transformer()
+    with pytest.raises(TypeError):
+        Estimator()
+
+
+class WithFn(Transformer, HasInputCol):
+    fn = Param(Params, "fn", "a callable", TypeConverters.toCallable)
+
+    def _transform(self, dataset):
+        return dataset
+
+
+def test_load_fails_loudly_on_unrestored_payload_params(tmp_path):
+    import pytest
+
+    t = WithFn()
+    t._set(fn=lambda x: x)
+    p = str(tmp_path / "fn")
+    t.save(p)
+    with pytest.raises(ValueError, match="fn"):
+        MLWritable.load(p)
