@@ -76,9 +76,12 @@ class DataFrame:
         parts = []
         for start in range(0, n, per):
             chunk = table.slice(start, per).combine_chunks()
-            parts.append(chunk.to_batches(max_chunksize=per)[0] if len(chunk)
-                         else pa.RecordBatch.from_pydict(
-                             {c: [] for c in table.column_names}))
+            if len(chunk):
+                parts.append(chunk.to_batches(max_chunksize=per)[0])
+            else:
+                parts.append(pa.RecordBatch.from_arrays(
+                    [pa.array([], type=f.type) for f in table.schema],
+                    schema=table.schema))
         return cls(parts)
 
     @classmethod

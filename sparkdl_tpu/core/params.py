@@ -22,7 +22,7 @@ from __future__ import annotations
 import copy as _copy
 import functools
 import inspect
-import threading
+import uuid
 from typing import Any, Callable
 
 
@@ -135,15 +135,11 @@ class TypeConverters:
         return shape
 
 
-_uid_lock = threading.Lock()
-_uid_counters: dict[str, int] = {}
-
-
 def _gen_uid(cls_name: str) -> str:
-    with _uid_lock:
-        n = _uid_counters.get(cls_name, 0)
-        _uid_counters[cls_name] = n + 1
-    return f"{cls_name}_{n:08x}"
+    # Random suffix (not a per-process counter): persisted uids from another
+    # process must not collide with freshly constructed instances, or the
+    # uid-based param-ownership checks silently cross wires.
+    return f"{cls_name}_{uuid.uuid4().hex[:12]}"
 
 
 def keyword_only(func):
@@ -279,14 +275,21 @@ class Params:
 
     def copy(self, extra: dict | None = None):
         """Deep-ish copy: new object, same uid (Spark semantics — a copy is the
-        *same stage* with possibly-overridden params, so uid is preserved)."""
+        *same stage* with possibly-overridden params, so uid is preserved).
+
+        ``extra`` may contain params owned by *other* stages; they are ignored
+        here (Spark semantics) so that one param map can be handed to a whole
+        Pipeline and each stage picks out its own entries."""
         that = _copy.copy(self)
         that._paramMap = dict(self._paramMap)
         that._defaultParamMap = dict(self._defaultParamMap)
         that._params_cache = None
         if extra:
             for p, v in extra.items():
-                that._paramMap[that._resolveParam(p)] = v
+                if isinstance(p, str):
+                    p = self.getParam(p)
+                if isinstance(p, Param) and p.parent == self.uid:
+                    that._paramMap[that.getParam(p.name)] = p.typeConverter(v)
         return that
 
     # -- docs --------------------------------------------------------------

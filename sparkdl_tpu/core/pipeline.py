@@ -230,6 +230,15 @@ class Pipeline(Estimator):
                     df = stage.transform(df)
         return PipelineModel(fitted)
 
+    def copy(self, extra: dict | None = None):
+        """Stage-owned params in ``extra`` flow into the matching stage —
+        the Spark Pipeline contract behind fit(df, params={stage.p: v})."""
+        that = super().copy(extra)
+        if self.isDefined(self.stages):
+            that._paramMap[that.getParam("stages")] = [
+                s.copy(extra) for s in self.getStages()]
+        return that
+
     def _save_payload(self, path: str):
         stages = self.getOrDefault(self.stages) if self.isDefined(self.stages) else []
         _save_stages(path, stages)
@@ -253,7 +262,7 @@ class PipelineModel(Model):
 
     def copy(self, extra: dict | None = None):
         that = super().copy(extra)
-        that.stages = [s.copy() for s in self.stages]
+        that.stages = [s.copy(extra) for s in self.stages]
         return that
 
     def _param_values_for_save(self):

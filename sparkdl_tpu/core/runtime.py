@@ -1,0 +1,225 @@
+"""Device runtime: mesh construction, compile cache, and the HBM feed pipeline.
+
+This layer plays the role the TF C++ runtime + TensorFrames JNI bridge played
+for the reference (SURVEY.md §2.3): getting partition batches from the columnar
+data plane into accelerator memory and running compiled programs over them.
+TPU-first design:
+
+- **Static shapes**: every batch entering a jitted function is padded to the
+  configured batch size, so XLA compiles exactly one program per (fn, shape)
+  — recompilation is the TPU equivalent of a cache miss storm.
+- **Double buffering**: ``prefetch_to_device`` keeps N batches in flight —
+  ``jax.device_put`` of batch k+1 overlaps with compute on batch k, hiding
+  host→HBM transfer latency behind MXU work. This is the "mapPartitions
+  batching feeding HBM directly" of the BASELINE north star.
+- **One mesh abstraction**: `make_mesh` builds a ``jax.sharding.Mesh`` over
+  the real device topology (or the virtual CPU devices in tests); all
+  parallelism (DP/TP/...) is expressed as shardings over its named axes and
+  compiled to ICI collectives by XLA — never hand-rolled NCCL-style calls.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import math
+import threading
+from typing import Any, Callable, Iterable, Iterator, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def devices() -> list:
+    return jax.devices()
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+def default_device():
+    return jax.devices()[0]
+
+
+def platform() -> str:
+    return jax.devices()[0].platform
+
+
+# ---------------------------------------------------------------------------
+# Mesh
+# ---------------------------------------------------------------------------
+
+def make_mesh(axes: dict[str, int] | None = None,
+              devices_: Sequence | None = None) -> Mesh:
+    """Build a named-axis device mesh.
+
+    ``axes`` maps axis name → size, e.g. ``{"data": 8}`` or
+    ``{"data": 4, "model": 2}``. A size of ``-1`` means "whatever is left".
+    Default: one ``data`` axis over all devices (pure DP — the reference's
+    only training parallelism, SURVEY.md §2.4).
+    """
+    devs = list(devices_ if devices_ is not None else jax.devices())
+    if axes is None:
+        axes = {"data": len(devs)}
+    names, sizes = list(axes.keys()), list(axes.values())
+    if sizes.count(-1) > 1:
+        raise ValueError("At most one mesh axis may be -1")
+    if -1 in sizes:
+        known = math.prod(s for s in sizes if s != -1)
+        if len(devs) % known:
+            raise ValueError(f"{len(devs)} devices not divisible by {known}")
+        sizes[sizes.index(-1)] = len(devs) // known
+    total = math.prod(sizes)
+    if total != len(devs):
+        raise ValueError(
+            f"Mesh axes {dict(zip(names, sizes))} need {total} devices, "
+            f"have {len(devs)}")
+    arr = np.array(devs).reshape(sizes)
+    return Mesh(arr, axis_names=tuple(names))
+
+
+def data_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Batch-dim sharding: leading dim split over the data axis."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+# ---------------------------------------------------------------------------
+# Batch padding (static shapes for XLA)
+# ---------------------------------------------------------------------------
+
+def pad_batch(arrays: dict[str, np.ndarray] | np.ndarray, batch_size: int):
+    """Pad leading dim up to ``batch_size``; returns (padded, n_valid).
+
+    Padding replicates row 0 (not zeros) so that models with
+    normalization/pooling never see degenerate inputs; validity is tracked by
+    count and the pad rows are sliced off after the computation.
+    """
+    single = not isinstance(arrays, dict)
+    d = {"x": arrays} if single else arrays
+    n = next(iter(d.values())).shape[0]
+    if n > batch_size:
+        raise ValueError(f"Batch of {n} rows exceeds batch size {batch_size}")
+    if n < batch_size:
+        out = {}
+        for k, v in d.items():
+            pad = np.broadcast_to(v[:1], (batch_size - n,) + v.shape[1:])
+            out[k] = np.concatenate([v, pad], axis=0)
+        d = out
+    return (d["x"] if single else d), n
+
+
+# ---------------------------------------------------------------------------
+# HBM prefetch pipeline
+# ---------------------------------------------------------------------------
+
+def prefetch_to_device(iterator: Iterable, size: int = 2,
+                       sharding: NamedSharding | None = None) -> Iterator:
+    """Double-buffered ``jax.device_put`` — the HBM feed pipeline.
+
+    Eagerly transfers up to ``size`` pytrees ahead of the consumer, so
+    host→device DMA of the next batch overlaps with device compute on the
+    current one. With a ``sharding``, each leaf is placed sharded across the
+    mesh (multi-chip feeding over ICI); otherwise onto the default device.
+    """
+    queue: collections.deque = collections.deque()
+
+    def put(batch):
+        if sharding is not None:
+            return jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, sharding), batch)
+        return jax.tree_util.tree_map(jax.device_put, batch)
+
+    it = iter(iterator)
+    for batch in itertools.islice(it, size):
+        queue.append(put(batch))
+    while queue:
+        out = queue.popleft()
+        nxt = next(it, None)
+        if nxt is not None:
+            queue.append(put(nxt))
+        yield out
+
+
+class BatchRunner:
+    """Drives one jitted function over a stream of host batches.
+
+    The execution engine behind every inference transformer: pads to a static
+    batch, prefetches into HBM, runs the compiled program, and slices off pad
+    rows. One XLA compilation per (fn, batch_size); the first call pays the
+    compile (~20-40s on the axon TPU), subsequent calls are cached.
+    """
+
+    def __init__(self, fn: Callable, batch_size: int, donate: bool = False,
+                 prefetch: int = 2):
+        self.batch_size = int(batch_size)
+        self.prefetch = prefetch
+        self._jitted = jax.jit(fn, donate_argnums=(0,) if donate else ())
+
+    def run(self, batches: Iterable[np.ndarray | dict]) -> Iterator[np.ndarray]:
+        """batches: iterator of host arrays/dicts with leading batch dim ≤
+        batch_size. Yields numpy outputs with pad rows removed."""
+
+        def staged():
+            for b in batches:
+                yield pad_batch(b, self.batch_size)
+        # Prefetch only the device-bound leaves; n_valid stays host-side.
+        arr_it, n_it = itertools.tee(staged())
+        dev_stream = prefetch_to_device((a for a, _ in arr_it), self.prefetch)
+        for dev_batch, (_, n) in zip(dev_stream, n_it):
+            out = self._jitted(dev_batch)
+            out_np = jax.tree_util.tree_map(np.asarray, out)
+            yield jax.tree_util.tree_map(lambda x: x[:n], out_np)
+
+
+def run_batched(fn: Callable, batches: Iterable, batch_size: int,
+                prefetch: int = 2) -> Iterator:
+    return BatchRunner(fn, batch_size, prefetch=prefetch).run(batches)
+
+
+# ---------------------------------------------------------------------------
+# Compile-once helper with explicit cache keying (diagnostics)
+# ---------------------------------------------------------------------------
+
+class CompileCache:
+    """Explicit jit cache keyed by (name, input treedef/shapes/dtypes).
+
+    jax.jit already caches per-signature; this wrapper adds *observability*
+    (hit/miss counters, recompile warnings) because silent recompilation is
+    the primary TPU performance failure mode."""
+
+    def __init__(self):
+        self._fns: dict[str, Any] = {}
+        self._keys: dict[str, set] = {}
+        self._lock = threading.Lock()
+        self.misses = 0
+        self.hits = 0
+
+    def get(self, name: str, fn: Callable, static_argnums=()) -> Callable:
+        with self._lock:
+            if name not in self._fns:
+                self._fns[name] = jax.jit(fn, static_argnums=static_argnums)
+                self._keys[name] = set()
+        jitted = self._fns[name]
+
+        def wrapped(*args, **kwargs):
+            key = jax.tree_util.tree_structure((args, kwargs)), tuple(
+                (getattr(x, "shape", None), str(getattr(x, "dtype", "")))
+                for x in jax.tree_util.tree_leaves((args, kwargs)))
+            with self._lock:
+                if key in self._keys[name]:
+                    self.hits += 1
+                else:
+                    self._keys[name].add(key)
+                    self.misses += 1
+            return jitted(*args, **kwargs)
+
+        return wrapped
+
+
+GLOBAL_COMPILE_CACHE = CompileCache()

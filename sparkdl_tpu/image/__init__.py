@@ -1,0 +1,11 @@
+from .imageIO import (decodeImage, encodePng, imageArrayToStruct,
+                      imageColumnToNHWC, imageSchema, imageStructToArray,
+                      nhwcToStructs, readImages, readImagesWithCustomFn,
+                      resizeImage, resizeImageBatchNHWC, structsToNHWC)
+
+__all__ = [
+    "imageSchema", "imageArrayToStruct", "imageStructToArray", "decodeImage",
+    "encodePng", "resizeImage", "resizeImageBatchNHWC", "structsToNHWC",
+    "imageColumnToNHWC", "nhwcToStructs", "readImages",
+    "readImagesWithCustomFn",
+]

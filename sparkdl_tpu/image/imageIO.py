@@ -1,0 +1,299 @@
+"""Image I/O: the image-struct schema and numpy converters.
+
+Re-creates the behavior of the reference's image layer (expected upstream file
+``python/sparkdl/image/imageIO.py`` + Scala ``ImageUtils.scala`` — SURVEY.md
+§1-L1/§2.1: image struct schema ``(height, width, nChannels, mode, data)``,
+bytes→struct decode, struct↔numpy conversion, resize, ``readImages*``).
+
+TPU-first deltas from the reference design:
+- The struct's ``data`` stays raw bytes in Arrow (one contiguous buffer per
+  image); batch assembly goes straight from the Arrow binary column into one
+  NHWC numpy array (``structsToNHWC``) that is handed to ``jax.device_put`` —
+  the per-row Python object churn of the reference's UDF path never happens.
+- At-rest layout matches Spark's ImageSchema: OpenCV mode codes AND OpenCV
+  channel order — 3/4-channel image data is stored **BGR(A)**, so structs are
+  interchangeable with Spark/reference-written data. The NHWC batch builders
+  emit RGB by default (the convention every model preprocess here expects)
+  and flip at the single batch-assembly point.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+from collections import namedtuple
+from typing import Callable, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+ImageFields = ["origin", "height", "width", "nChannels", "mode", "data"]
+
+# OpenCV type codes, as used by Spark's ImageSchema (and the reference's
+# OCV-type mapping). dtype + channel count → code.
+_OcvType = namedtuple("_OcvType", ["name", "ord", "nChannels", "dtype"])
+
+_SUPPORTED_OCV_TYPES = (
+    _OcvType(name="CV_8UC1", ord=0, nChannels=1, dtype="uint8"),
+    _OcvType(name="CV_8UC3", ord=16, nChannels=3, dtype="uint8"),
+    _OcvType(name="CV_8UC4", ord=24, nChannels=4, dtype="uint8"),
+    _OcvType(name="CV_32FC1", ord=5, nChannels=1, dtype="float32"),
+    _OcvType(name="CV_32FC3", ord=21, nChannels=3, dtype="float32"),
+    _OcvType(name="CV_32FC4", ord=29, nChannels=4, dtype="float32"),
+)
+_OCV_BY_ORD = {t.ord: t for t in _SUPPORTED_OCV_TYPES}
+_OCV_BY_KEY = {(t.dtype, t.nChannels): t for t in _SUPPORTED_OCV_TYPES}
+
+imageSchema = pa.struct([
+    ("origin", pa.string()),
+    ("height", pa.int32()),
+    ("width", pa.int32()),
+    ("nChannels", pa.int32()),
+    ("mode", pa.int32()),
+    ("data", pa.binary()),
+])
+
+
+def ocvTypeByMode(mode: int) -> _OcvType:
+    try:
+        return _OCV_BY_ORD[mode]
+    except KeyError:
+        raise ValueError(f"Unsupported OpenCV image mode {mode}; supported: "
+                         f"{sorted(_OCV_BY_ORD)}") from None
+
+
+def imageArrayToStruct(array: np.ndarray, origin: str = "") -> dict:
+    """HWC numpy array → image struct dict (Arrow-storable)."""
+    if array.ndim == 2:
+        array = array[:, :, None]
+    if array.ndim != 3:
+        raise ValueError(f"Expected HW or HWC array, got shape {array.shape}")
+    h, w, c = array.shape
+    key = (str(array.dtype), c)
+    if key not in _OCV_BY_KEY:
+        raise ValueError(f"Unsupported dtype/channels {key}; supported: "
+                         f"{sorted(_OCV_BY_KEY)}")
+    t = _OCV_BY_KEY[key]
+    return {
+        "origin": origin,
+        "height": int(h),
+        "width": int(w),
+        "nChannels": int(c),
+        "mode": t.ord,
+        "data": np.ascontiguousarray(array).tobytes(),
+    }
+
+
+def imageStructToArray(struct: dict) -> np.ndarray:
+    """Image struct dict → HWC numpy array (dtype per the mode's OCV type)."""
+    t = ocvTypeByMode(struct["mode"])
+    arr = np.frombuffer(struct["data"], dtype=t.dtype)
+    expected = struct["height"] * struct["width"] * struct["nChannels"]
+    if arr.size != expected:
+        raise ValueError(
+            f"Image data has {arr.size} elements, expected {expected} "
+            f"({struct['height']}x{struct['width']}x{struct['nChannels']})")
+    return arr.reshape(struct["height"], struct["width"], struct["nChannels"])
+
+
+def decodeImage(data: bytes, origin: str = "") -> dict | None:
+    """Compressed image bytes (PNG/JPEG/...) → image struct; None if undecodable
+    (matching the reference's drop-bad-images behavior). Stored channel order
+    is BGR(A), per the Spark/OpenCV at-rest convention."""
+    from PIL import Image
+    try:
+        img = Image.open(io.BytesIO(data))
+        img = _normalize_pil_mode(img)
+        arr = np.asarray(img, dtype=np.uint8)
+    except Exception:
+        return None
+    if arr.ndim == 3 and arr.shape[2] >= 3:
+        arr = np.ascontiguousarray(arr[:, :, ::-1])  # RGB(A) → BGR(A)
+    return imageArrayToStruct(arr, origin=origin)
+
+
+def _normalize_pil_mode(img):
+    if img.mode in ("L",):
+        return img
+    if img.mode in ("RGBA", "P", "CMYK"):
+        return img.convert("RGBA") if img.mode == "RGBA" else img.convert("RGB")
+    if img.mode != "RGB":
+        return img.convert("RGB")
+    return img
+
+
+def encodePng(struct: dict) -> bytes:
+    from PIL import Image
+    arr = imageStructToArray(struct)
+    if arr.dtype != np.uint8:
+        raise ValueError("encodePng requires uint8 image structs")
+    if arr.shape[2] >= 3:
+        arr = arr[:, :, ::-1]  # stored BGR(A) → RGB(A) for PIL
+    buf = io.BytesIO()
+    Image.fromarray(arr.squeeze() if arr.shape[2] == 1 else arr).save(
+        buf, format="PNG")
+    return buf.getvalue()
+
+
+def resizeImage(struct: dict, height: int, width: int) -> dict:
+    """Bilinear resize of one image struct (PIL, uint8 path)."""
+    from PIL import Image
+    arr = imageStructToArray(struct)
+    if arr.dtype != np.uint8:
+        raise ValueError("resizeImage supports uint8 structs")
+    img = Image.fromarray(arr.squeeze() if arr.shape[2] == 1 else arr)
+    resized = np.asarray(img.resize((width, height), Image.BILINEAR),
+                         dtype=np.uint8)
+    if resized.ndim == 2:
+        resized = resized[:, :, None]
+    return imageArrayToStruct(resized, origin=struct.get("origin", ""))
+
+
+def resizeImageBatchNHWC(batch: np.ndarray, height: int, width: int) -> np.ndarray:
+    """Vectorized NHWC resize on device-bound data.
+
+    Uses ``jax.image.resize`` (XLA gather-based bilinear) so resize fuses into
+    the same compiled program as preprocessing — the reference instead resized
+    row-at-a-time in a Spark UDF (SURVEY.md §3.1 step 2).
+    """
+    import jax
+    n, _, _, c = batch.shape
+    return np.asarray(jax.image.resize(
+        batch, (n, height, width, c), method="bilinear"))
+
+
+def structsToNHWC(structs: Sequence[dict], height: int | None = None,
+                  width: int | None = None, dtype=np.float32,
+                  channelOrder: str = "RGB") -> np.ndarray:
+    """Column of image structs → one contiguous NHWC batch array.
+
+    Structs store BGR(A) at rest; ``channelOrder="RGB"`` (default) flips to
+    the model convention here, at the single batch-assembly point. Mixed sizes
+    are resized (PIL) to (height, width); if not given, all images must share
+    one shape.
+    """
+    if not structs:
+        raise ValueError("empty image column")
+    first = structs[0]
+    h = height if height is not None else first["height"]
+    w = width if width is not None else first["width"]
+    c = first["nChannels"]
+    flip = channelOrder.upper() == "RGB" and c >= 3
+    out = np.empty((len(structs), h, w, c), dtype=dtype)
+    for i, s in enumerate(structs):
+        if s["nChannels"] != c:
+            raise ValueError(f"Row {i}: channel mismatch {s['nChannels']} != {c}")
+        if s["height"] != h or s["width"] != w:
+            s = resizeImage(s, h, w)
+        arr = imageStructToArray(s)
+        out[i] = arr[:, :, ::-1] if flip else arr
+    return out
+
+
+def imageColumnToNHWC(column: pa.Array, height: int | None = None,
+                      width: int | None = None, dtype=np.float32,
+                      channelOrder: str = "RGB") -> np.ndarray:
+    """Arrow struct column → NHWC batch, reading the struct's child arrays
+    directly (no per-row Python dict materialization on this hot boundary).
+
+    Uniform-size rows are filled via zero-copy ``np.frombuffer`` views of the
+    Arrow binary buffers; only rows needing a resize detour through PIL.
+    """
+    if isinstance(column, pa.ChunkedArray):
+        column = column.combine_chunks()
+    n = len(column)
+    if n == 0:
+        raise ValueError("empty image column")
+    heights = column.field("height").to_numpy(zero_copy_only=False)
+    widths = column.field("width").to_numpy(zero_copy_only=False)
+    chans = column.field("nChannels").to_numpy(zero_copy_only=False)
+    modes = column.field("mode").to_numpy(zero_copy_only=False)
+    data = column.field("data")
+    h = int(height) if height is not None else int(heights[0])
+    w = int(width) if width is not None else int(widths[0])
+    c = int(chans[0])
+    if not (chans == c).all():
+        raise ValueError(f"Mixed channel counts in image column: "
+                         f"{sorted(set(chans.tolist()))}")
+    flip = channelOrder.upper() == "RGB" and c >= 3
+    out = np.empty((n, h, w, c), dtype=dtype)
+    for i in range(n):
+        src_dtype = ocvTypeByMode(int(modes[i])).dtype
+        view = np.frombuffer(data[i].as_buffer(), dtype=src_dtype)
+        if heights[i] == h and widths[i] == w:
+            img = view.reshape(h, w, c)
+        else:
+            struct = {"height": int(heights[i]), "width": int(widths[i]),
+                      "nChannels": c, "mode": int(modes[i]),
+                      "data": view.tobytes()}
+            img = imageStructToArray(resizeImage(struct, h, w))
+        out[i] = img[:, :, ::-1] if flip else img
+    return out
+
+
+def nhwcToStructs(batch: np.ndarray, origins: Sequence[str] | None = None,
+                  channelOrder: str = "RGB") -> list[dict]:
+    """NHWC batch → image structs. Input is RGB by default (the model
+    convention); stored structs are BGR per the at-rest convention."""
+    origins = origins or [""] * len(batch)
+    flip = channelOrder.upper() == "RGB" and batch.shape[-1] >= 3
+    return [imageArrayToStruct(
+        np.ascontiguousarray(np.asarray(img)[:, :, ::-1]) if flip
+        else np.asarray(img), origin=o)
+        for img, o in zip(batch, origins)]
+
+
+# ---------------------------------------------------------------------------
+# Readers (reference: readImages / readImagesWithCustomFn)
+# ---------------------------------------------------------------------------
+
+_IMAGE_EXTENSIONS = {".jpg", ".jpeg", ".png", ".gif", ".bmp", ".webp"}
+
+
+def _list_image_files(path: str, recursive: bool = True) -> list[str]:
+    if os.path.isfile(path):
+        return [path]
+    files = []
+    for root, _dirs, names in os.walk(path):
+        for n in sorted(names):
+            if os.path.splitext(n)[1].lower() in _IMAGE_EXTENSIONS:
+                files.append(os.path.join(root, n))
+        if not recursive:
+            break
+    return files
+
+
+def readImages(path: str, numPartitions: int = 1, dropImageFailures: bool = True):
+    """Directory/file of images → DataFrame[image: imageSchema].
+
+    Reference behavior: ``readImages`` returns a DataFrame with an ``image``
+    struct column, silently dropping undecodable files when asked.
+    """
+    return readImagesWithCustomFn(path, decode_fn=decodeImage,
+                                  numPartitions=numPartitions,
+                                  dropImageFailures=dropImageFailures)
+
+
+def readImagesWithCustomFn(path: str, decode_fn: Callable[[bytes, str], dict | None],
+                           numPartitions: int = 1,
+                           dropImageFailures: bool = True):
+    from ..core.frame import DataFrame
+    files = _list_image_files(path)
+    if not files:
+        raise FileNotFoundError(f"No image files under {path!r}")
+    structs, origins = [], []
+    for f in files:
+        with open(f, "rb") as fh:
+            s = decode_fn(fh.read(), f)
+        if s is None:
+            if dropImageFailures:
+                continue
+            s = {"origin": f, "height": -1, "width": -1, "nChannels": -1,
+                 "mode": -1, "data": b""}
+        structs.append(s)
+        origins.append(f)
+    if not structs:
+        raise ValueError(f"All {len(files)} image files failed to decode")
+    arr = pa.array(structs, type=imageSchema)
+    table = pa.table({"image": arr})
+    return DataFrame.fromArrow(table, numPartitions=numPartitions)

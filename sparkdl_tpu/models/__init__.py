@@ -1,0 +1,10 @@
+from .registry import (SUPPORTED_MODELS, NamedImageModel, decodePredictions,
+                       get_model, load_safetensors, load_weights,
+                       preprocess_caffe, preprocess_tf, preprocess_torch,
+                       save_safetensors, save_weights)
+
+__all__ = [
+    "SUPPORTED_MODELS", "NamedImageModel", "get_model", "decodePredictions",
+    "preprocess_tf", "preprocess_caffe", "preprocess_torch",
+    "save_weights", "load_weights", "load_safetensors", "save_safetensors",
+]

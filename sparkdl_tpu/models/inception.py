@@ -1,0 +1,170 @@
+"""InceptionV3 in Flax linen — the flagship DeepImageFeaturizer model.
+
+Reference: the named-model registry's InceptionV3 entry (input 299x299,
+bottleneck = 2048-d global-average-pool features — SURVEY.md §2.1, BASELINE
+config 1). Architecture follows Szegedy et al. 2015 ("Rethinking the Inception
+Architecture", arXiv:1512.00567): factorized 7x7 branches, grid reductions,
+expanded-filter-bank mixed9/10 blocks. Implemented NHWC with fused
+conv+bn+relu units, single traced graph, dtype knob for bf16 MXU compute.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvBN(nn.Module):
+    filters: int
+    kernel: tuple[int, int]
+    strides: tuple[int, int] = (1, 1)
+    padding: str = "SAME"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(self.filters, self.kernel, strides=self.strides,
+                    padding=self.padding, use_bias=False, dtype=self.dtype,
+                    name="conv")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9997,
+                         epsilon=1e-3, dtype=self.dtype, name="bn")(x)
+        return nn.relu(x)
+
+
+def _avg_pool_same(x):
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME",
+                       count_include_pad=False)
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cbn = lambda f, k, name: ConvBN(f, k, dtype=self.dtype, name=name)
+        b1 = cbn(64, (1, 1), "b1x1")(x, train)
+        b5 = cbn(48, (1, 1), "b5x5_1")(x, train)
+        b5 = cbn(64, (5, 5), "b5x5_2")(b5, train)
+        b3 = cbn(64, (1, 1), "b3x3dbl_1")(x, train)
+        b3 = cbn(96, (3, 3), "b3x3dbl_2")(b3, train)
+        b3 = cbn(96, (3, 3), "b3x3dbl_3")(b3, train)
+        bp = _avg_pool_same(x)
+        bp = cbn(self.pool_features, (1, 1), "bpool")(bp, train)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class InceptionB(nn.Module):
+    """Grid reduction 35→17."""
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cbn = lambda f, k, name, s=(1, 1), p="SAME": ConvBN(
+            f, k, strides=s, padding=p, dtype=self.dtype, name=name)
+        b3 = cbn(384, (3, 3), "b3x3", s=(2, 2), p="VALID")(x, train)
+        bd = cbn(64, (1, 1), "b3x3dbl_1")(x, train)
+        bd = cbn(96, (3, 3), "b3x3dbl_2")(bd, train)
+        bd = cbn(96, (3, 3), "b3x3dbl_3", s=(2, 2), p="VALID")(bd, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class InceptionC(nn.Module):
+    """Factorized 7x7 branches."""
+    c7: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cbn = lambda f, k, name: ConvBN(f, k, dtype=self.dtype, name=name)
+        c7 = self.c7
+        b1 = cbn(192, (1, 1), "b1x1")(x, train)
+        b7 = cbn(c7, (1, 1), "b7x7_1")(x, train)
+        b7 = cbn(c7, (1, 7), "b7x7_2")(b7, train)
+        b7 = cbn(192, (7, 1), "b7x7_3")(b7, train)
+        bd = cbn(c7, (1, 1), "b7x7dbl_1")(x, train)
+        bd = cbn(c7, (7, 1), "b7x7dbl_2")(bd, train)
+        bd = cbn(c7, (1, 7), "b7x7dbl_3")(bd, train)
+        bd = cbn(c7, (7, 1), "b7x7dbl_4")(bd, train)
+        bd = cbn(192, (1, 7), "b7x7dbl_5")(bd, train)
+        bp = _avg_pool_same(x)
+        bp = cbn(192, (1, 1), "bpool")(bp, train)
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class InceptionD(nn.Module):
+    """Grid reduction 17→8."""
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cbn = lambda f, k, name, s=(1, 1), p="SAME": ConvBN(
+            f, k, strides=s, padding=p, dtype=self.dtype, name=name)
+        b3 = cbn(192, (1, 1), "b3x3_1")(x, train)
+        b3 = cbn(320, (3, 3), "b3x3_2", s=(2, 2), p="VALID")(b3, train)
+        b7 = cbn(192, (1, 1), "b7x7x3_1")(x, train)
+        b7 = cbn(192, (1, 7), "b7x7x3_2")(b7, train)
+        b7 = cbn(192, (7, 1), "b7x7x3_3")(b7, train)
+        b7 = cbn(192, (3, 3), "b7x7x3_4", s=(2, 2), p="VALID")(b7, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class InceptionE(nn.Module):
+    """Expanded filter bank (split 3x3 into 1x3 + 3x1)."""
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cbn = lambda f, k, name: ConvBN(f, k, dtype=self.dtype, name=name)
+        b1 = cbn(320, (1, 1), "b1x1")(x, train)
+        b3 = cbn(384, (1, 1), "b3x3_1")(x, train)
+        b3 = jnp.concatenate([cbn(384, (1, 3), "b3x3_2a")(b3, train),
+                              cbn(384, (3, 1), "b3x3_2b")(b3, train)], axis=-1)
+        bd = cbn(448, (1, 1), "b3x3dbl_1")(x, train)
+        bd = cbn(384, (3, 3), "b3x3dbl_2")(bd, train)
+        bd = jnp.concatenate([cbn(384, (1, 3), "b3x3dbl_3a")(bd, train),
+                              cbn(384, (3, 1), "b3x3dbl_3b")(bd, train)],
+                             axis=-1)
+        bp = _avg_pool_same(x)
+        bp = cbn(192, (1, 1), "bpool")(bp, train)
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False, features_only: bool = False):
+        x = x.astype(self.dtype)
+        cbn = lambda f, k, name, s=(1, 1), p="VALID": ConvBN(
+            f, k, strides=s, padding=p, dtype=self.dtype, name=name)
+        # Stem: 299x299x3 → 35x35x192
+        x = cbn(32, (3, 3), "stem1", s=(2, 2))(x, train)
+        x = cbn(32, (3, 3), "stem2")(x, train)
+        x = cbn(64, (3, 3), "stem3", p="SAME")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = cbn(80, (1, 1), "stem4")(x, train)
+        x = cbn(192, (3, 3), "stem5")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        # Mixed blocks
+        x = InceptionA(32, dtype=self.dtype, name="mixed0")(x, train)
+        x = InceptionA(64, dtype=self.dtype, name="mixed1")(x, train)
+        x = InceptionA(64, dtype=self.dtype, name="mixed2")(x, train)
+        x = InceptionB(dtype=self.dtype, name="mixed3")(x, train)
+        x = InceptionC(128, dtype=self.dtype, name="mixed4")(x, train)
+        x = InceptionC(160, dtype=self.dtype, name="mixed5")(x, train)
+        x = InceptionC(160, dtype=self.dtype, name="mixed6")(x, train)
+        x = InceptionC(192, dtype=self.dtype, name="mixed7")(x, train)
+        x = InceptionD(dtype=self.dtype, name="mixed8")(x, train)
+        x = InceptionE(dtype=self.dtype, name="mixed9")(x, train)
+        x = InceptionE(dtype=self.dtype, name="mixed10")(x, train)
+        x = jnp.mean(x, axis=(1, 2))  # 8x8x2048 → 2048 (the bottleneck)
+        x = x.astype(jnp.float32)
+        if features_only:
+            return x
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)

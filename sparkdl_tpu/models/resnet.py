@@ -1,0 +1,112 @@
+"""ResNet v1 family in Flax linen, NHWC, TPU-first.
+
+Provides the ResNet50 named model of the reference registry (expected upstream
+``python/sparkdl/transformers/keras_applications.py`` — SURVEY.md §2.1) plus
+the rest of the v1 family. Written for the MXU: NHWC layout (XLA:TPU's native
+conv layout), a ``dtype`` knob for bfloat16 compute with float32 params, and
+no data-dependent Python control flow — the whole forward pass is one traced
+graph.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 → 3x3 → 1x1 bottleneck with projection shortcut on shape change."""
+    filters: int
+    strides: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        residual = x
+        y = conv(self.filters, (1, 1), name="conv1")(x)
+        y = norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides),
+                 name="conv2")(y)
+        y = norm(name="bn2")(y)
+        y = nn.relu(y)
+        y = conv(self.filters * 4, (1, 1), name="conv3")(y)
+        y = norm(name="bn3")(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters * 4, (1, 1),
+                            strides=(self.strides, self.strides),
+                            name="proj_conv")(x)
+            residual = norm(name="proj_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class BasicBlock(nn.Module):
+    """3x3 → 3x3 block (ResNet-18/34)."""
+    filters: int
+    strides: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        residual = x
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides),
+                 name="conv1")(x)
+        y = norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3), name="conv2")(y)
+        y = norm(name="bn2")(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters, (1, 1),
+                            strides=(self.strides, self.strides),
+                            name="proj_conv")(x)
+            residual = norm(name="proj_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """ResNet v1. ``__call__(x, features_only=True)`` yields the pooled
+    bottleneck features — the featurizer output of DeepImageFeaturizer."""
+    stage_sizes: Sequence[int]
+    block: ModuleDef
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False, features_only: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=self.dtype, name="stem_conv")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=self.dtype, name="stem_bn")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block(self.width * 2 ** i, strides, dtype=self.dtype,
+                               name=f"stage{i + 1}_block{j + 1}")(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool → (N, C)
+        x = x.astype(jnp.float32)
+        if features_only:
+            return x
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+ResNet18 = partial(ResNet, stage_sizes=[2, 2, 2, 2], block=BasicBlock)
+ResNet34 = partial(ResNet, stage_sizes=[3, 4, 6, 3], block=BasicBlock)
+ResNet50 = partial(ResNet, stage_sizes=[3, 4, 6, 3], block=BottleneckBlock)
+ResNet101 = partial(ResNet, stage_sizes=[3, 4, 23, 3], block=BottleneckBlock)
+ResNet152 = partial(ResNet, stage_sizes=[3, 8, 36, 3], block=BottleneckBlock)
+

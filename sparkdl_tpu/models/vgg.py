@@ -1,0 +1,41 @@
+"""VGG16/VGG19 in Flax linen (reference registry models — SURVEY.md §2.1).
+
+The reference featurizer takes VGG's fc2 (4096-d) activations as the
+bottleneck; we mirror that: ``features_only`` returns the post-fc2 ReLU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class VGG(nn.Module):
+    cfg: Sequence[int]  # conv counts per block
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False, features_only: bool = False):
+        x = x.astype(self.dtype)
+        widths = [64, 128, 256, 512, 512]
+        for b, (n_convs, w) in enumerate(zip(self.cfg, widths)):
+            for c in range(n_convs):
+                x = nn.Conv(w, (3, 3), padding="SAME", dtype=self.dtype,
+                            name=f"block{b + 1}_conv{c + 1}")(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype, name="fc1")(x))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype, name="fc2")(x))
+        x = x.astype(jnp.float32)
+        if features_only:
+            return x
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+VGG16 = partial(VGG, cfg=[2, 2, 3, 3, 3])
+VGG19 = partial(VGG, cfg=[2, 2, 4, 4, 4])

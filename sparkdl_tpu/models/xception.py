@@ -1,0 +1,110 @@
+"""Xception in Flax linen (reference registry model — SURVEY.md §2.1).
+
+Chollet 2017 (arXiv:1610.02357): depthwise-separable conv stacks with linear
+residuals. Separable conv = depthwise (feature_group_count=channels) + 1x1
+pointwise — both map cleanly onto XLA:TPU convolution; NHWC throughout.
+Input 299x299, bottleneck = 2048-d global-average-pool features.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class SeparableConvBN(nn.Module):
+    filters: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        in_ch = x.shape[-1]
+        x = nn.Conv(in_ch, (3, 3), padding="SAME", feature_group_count=in_ch,
+                    use_bias=False, dtype=self.dtype, name="depthwise")(x)
+        x = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype,
+                    name="pointwise")(x)
+        return nn.BatchNorm(use_running_average=not train, momentum=0.99,
+                            epsilon=1e-3, dtype=self.dtype, name="bn")(x)
+
+
+class XceptionBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    relu_first: bool = True
+    grow_first: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        residual = x
+        y = x
+        for i in range(2):
+            if self.relu_first or i > 0:
+                y = nn.relu(y)
+            y = SeparableConvBN(self.filters, dtype=self.dtype,
+                                name=f"sep{i + 1}")(y, train)
+        if self.strides > 1:
+            y = nn.max_pool(y, (3, 3), strides=(self.strides, self.strides),
+                            padding="SAME")
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1),
+                               strides=(self.strides, self.strides),
+                               use_bias=False, dtype=self.dtype,
+                               name="proj_conv")(x)
+            residual = nn.BatchNorm(use_running_average=not train,
+                                    momentum=0.99, epsilon=1e-3,
+                                    dtype=self.dtype, name="proj_bn")(residual)
+        return y + residual
+
+
+class Xception(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False, features_only: bool = False):
+        x = x.astype(self.dtype)
+        norm = lambda name: nn.BatchNorm(use_running_average=not train,
+                                         momentum=0.99, epsilon=1e-3,
+                                         dtype=self.dtype, name=name)
+        # Entry flow
+        x = nn.Conv(32, (3, 3), strides=(2, 2), use_bias=False,
+                    dtype=self.dtype, name="stem_conv1")(x)
+        x = nn.relu(norm("stem_bn1")(x))
+        x = nn.Conv(64, (3, 3), use_bias=False, dtype=self.dtype,
+                    name="stem_conv2")(x)
+        x = nn.relu(norm("stem_bn2")(x))
+        x = XceptionBlock(128, strides=2, relu_first=False, dtype=self.dtype,
+                          name="entry1")(x, train)
+        x = XceptionBlock(256, strides=2, dtype=self.dtype, name="entry2")(x, train)
+        x = XceptionBlock(728, strides=2, dtype=self.dtype, name="entry3")(x, train)
+        # Middle flow: 8 identity blocks of 3 separable convs
+        for i in range(8):
+            residual = x
+            y = x
+            for j in range(3):
+                y = nn.relu(y)
+                y = SeparableConvBN(728, dtype=self.dtype,
+                                    name=f"middle{i + 1}_sep{j + 1}")(y, train)
+            x = y + residual
+        # Exit flow
+        residual = nn.Conv(1024, (1, 1), strides=(2, 2), use_bias=False,
+                           dtype=self.dtype, name="exit_proj_conv")(x)
+        residual = norm("exit_proj_bn")(residual)
+        y = nn.relu(x)
+        y = SeparableConvBN(728, dtype=self.dtype, name="exit_sep1")(y, train)
+        y = nn.relu(y)
+        y = SeparableConvBN(1024, dtype=self.dtype, name="exit_sep2")(y, train)
+        y = nn.max_pool(y, (3, 3), strides=(2, 2), padding="SAME")
+        x = y + residual
+        x = SeparableConvBN(1536, dtype=self.dtype, name="exit_sep3")(x, train)
+        x = nn.relu(x)
+        x = SeparableConvBN(2048, dtype=self.dtype, name="exit_sep4")(x, train)
+        x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = x.astype(jnp.float32)
+        if features_only:
+            return x
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)

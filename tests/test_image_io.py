@@ -1,0 +1,148 @@
+"""Image I/O tests: struct schema, converters, decode/resize, readers."""
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu.image import imageIO
+
+
+def rand_img(h=8, w=6, c=3, dtype=np.uint8, seed=0):
+    rng = np.random.default_rng(seed)
+    if dtype == np.uint8:
+        return rng.integers(0, 256, size=(h, w, c), dtype=np.uint8)
+    return rng.random((h, w, c), dtype=np.float32)
+
+
+def test_array_struct_roundtrip_uint8_and_float():
+    for dtype in (np.uint8, np.float32):
+        img = rand_img(dtype=dtype)
+        s = imageIO.imageArrayToStruct(img, origin="mem")
+        assert s["height"] == 8 and s["width"] == 6 and s["nChannels"] == 3
+        back = imageIO.imageStructToArray(s)
+        assert back.dtype == img.dtype
+        np.testing.assert_array_equal(back, img)
+
+
+def test_mode_codes_match_opencv_numbering():
+    # Spark ImageSchema / OpenCV type codes: CV_8UC3 == 16, CV_8UC1 == 0.
+    assert imageIO.imageArrayToStruct(rand_img(c=3))["mode"] == 16
+    assert imageIO.imageArrayToStruct(rand_img(c=1))["mode"] == 0
+    assert imageIO.imageArrayToStruct(rand_img(c=4))["mode"] == 24
+    assert imageIO.imageArrayToStruct(
+        rand_img(dtype=np.float32))["mode"] == 21
+    with pytest.raises(ValueError):
+        imageIO.ocvTypeByMode(99)
+
+
+def test_grayscale_2d_promoted():
+    img2d = np.zeros((4, 5), dtype=np.uint8)
+    s = imageIO.imageArrayToStruct(img2d)
+    assert s["nChannels"] == 1
+    assert imageIO.imageStructToArray(s).shape == (4, 5, 1)
+
+
+def test_decode_encode_png_roundtrip():
+    img = rand_img()
+    png = imageIO.encodePng(imageIO.imageArrayToStruct(img))
+    s = imageIO.decodeImage(png, origin="x.png")
+    assert s is not None and s["origin"] == "x.png"
+    np.testing.assert_array_equal(imageIO.imageStructToArray(s), img)
+
+
+def test_decode_garbage_returns_none():
+    assert imageIO.decodeImage(b"not an image") is None
+
+
+def test_resize():
+    img = rand_img(h=10, w=10)
+    s = imageIO.resizeImage(imageIO.imageArrayToStruct(img), 4, 6)
+    assert (s["height"], s["width"]) == (4, 6)
+    arr = imageIO.imageStructToArray(s)
+    assert arr.shape == (4, 6, 3)
+
+
+def test_structs_to_nhwc_mixed_sizes():
+    imgs = [rand_img(8, 8, 3, seed=i) for i in range(3)]
+    structs = [imageIO.imageArrayToStruct(im) for im in imgs]
+    structs.append(imageIO.imageArrayToStruct(rand_img(16, 12, 3, seed=9)))
+    batch = imageIO.structsToNHWC(structs, height=8, width=8)
+    assert batch.shape == (4, 8, 8, 3)
+    assert batch.dtype == np.float32
+    # structs store BGR at rest; default output is RGB (flipped)
+    np.testing.assert_allclose(batch[0], imgs[0][:, :, ::-1].astype(np.float32))
+    raw = imageIO.structsToNHWC(structs, height=8, width=8, channelOrder="BGR")
+    np.testing.assert_allclose(raw[0], imgs[0].astype(np.float32))
+
+
+def test_structs_to_nhwc_channel_mismatch_raises():
+    structs = [imageIO.imageArrayToStruct(rand_img(c=3)),
+               imageIO.imageArrayToStruct(rand_img(c=1))]
+    with pytest.raises(ValueError, match="channel mismatch"):
+        imageIO.structsToNHWC(structs)
+
+
+def test_resize_batch_nhwc_xla():
+    batch = np.stack([rand_img(12, 12, 3, seed=i) for i in range(2)]
+                     ).astype(np.float32)
+    out = imageIO.resizeImageBatchNHWC(batch, 6, 6)
+    assert out.shape == (2, 6, 6, 3)
+
+
+def test_read_images_dir(tmp_path):
+    from PIL import Image
+    for i in range(4):
+        Image.fromarray(rand_img(seed=i)).save(tmp_path / f"img_{i}.png")
+    (tmp_path / "junk.png").write_bytes(b"broken")
+    (tmp_path / "notes.txt").write_text("ignored")
+
+    df = imageIO.readImages(str(tmp_path), numPartitions=2)
+    rows = df.collect()
+    assert len(rows) == 4  # broken file dropped, txt ignored
+    assert df.numPartitions == 2
+    img0 = rows[0].image
+    assert img0["mode"] == 16 and img0["height"] == 8
+    assert img0["origin"].endswith(".png")
+
+    with pytest.raises(FileNotFoundError):
+        imageIO.readImages(str(tmp_path / "empty-dir"))
+
+
+def test_read_images_keep_failures(tmp_path):
+    from PIL import Image
+    Image.fromarray(rand_img()).save(tmp_path / "ok.png")
+    (tmp_path / "bad.png").write_bytes(b"broken")
+    df = imageIO.readImages(str(tmp_path), dropImageFailures=False)
+    rows = sorted(df.collect(), key=lambda r: r.image["origin"])
+    assert rows[0].image["height"] == -1  # failure sentinel row kept
+    assert rows[1].image["height"] == 8
+
+
+def test_bgr_at_rest_convention():
+    # decodeImage must store BGR (Spark/OpenCV at-rest layout): a pure-red
+    # PNG decodes to a struct whose first byte-plane is blue==0, last is red.
+    from PIL import Image
+    import io as _io
+    red = np.zeros((4, 4, 3), np.uint8)
+    red[:, :, 0] = 255  # RGB red
+    buf = _io.BytesIO()
+    Image.fromarray(red).save(buf, format="PNG")
+    s = imageIO.decodeImage(buf.getvalue())
+    stored = imageIO.imageStructToArray(s)
+    assert stored[0, 0, 0] == 0 and stored[0, 0, 2] == 255  # B,G,R order
+    # and the model-facing NHWC batch is back in RGB
+    batch = imageIO.structsToNHWC([s])
+    assert batch[0, 0, 0, 0] == 255 and batch[0, 0, 0, 2] == 0
+
+
+def test_image_column_to_nhwc_matches_structs_path(tmp_path):
+    from PIL import Image
+    for i in range(3):
+        Image.fromarray(rand_img(seed=i)).save(tmp_path / f"i{i}.png")
+    Image.fromarray(rand_img(12, 10, 3, seed=7)).save(tmp_path / "big.png")
+    df = imageIO.readImages(str(tmp_path))
+    part = next(df.iterPartitions())
+    col = part.column("image")
+    fast = imageIO.imageColumnToNHWC(col, 8, 6)
+    slow = imageIO.structsToNHWC(col.to_pylist(), 8, 6)
+    np.testing.assert_array_equal(fast, slow)
+    assert fast.shape == (4, 8, 6, 3)

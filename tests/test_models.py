@@ -1,0 +1,117 @@
+"""Model zoo tests: shapes, feature dims, determinism, weight round-trips.
+
+Runs on the virtual CPU mesh with small batches; full 299x299 InceptionV3
+forward is exercised once (it is the flagship featurizer). Heavier archs are
+shape-checked at reduced spatial size where the architecture allows.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparkdl_tpu.models import registry
+from sparkdl_tpu.models.registry import get_model
+
+
+def test_registry_contents_match_reference_surface():
+    # The reference's SUPPORTED_MODELS: InceptionV3, Xception, ResNet50,
+    # VGG16, VGG19 (SURVEY.md §2.1). Extras are allowed, absences are not.
+    for name in ["InceptionV3", "Xception", "ResNet50", "VGG16", "VGG19"]:
+        m = get_model(name)
+        assert m.feature_dim in (2048, 4096)
+    with pytest.raises(ValueError, match="Unknown model"):
+        get_model("NopeNet")
+
+
+def test_preprocess_functions():
+    x = jnp.full((1, 2, 2, 3), 255.0)
+    np.testing.assert_allclose(registry.preprocess_tf(x), 1.0)
+    caffe = registry.preprocess_caffe(jnp.zeros((1, 1, 1, 3)))
+    np.testing.assert_allclose(
+        np.asarray(caffe)[0, 0, 0], [-103.939, -116.779, -123.68], rtol=1e-5)
+    t = registry.preprocess_torch(jnp.full((1, 1, 1, 3), 255.0))
+    np.testing.assert_allclose(
+        np.asarray(t)[0, 0, 0],
+        (1.0 - np.array([0.485, 0.456, 0.406])) / np.array([0.229, 0.224, 0.225]),
+        rtol=1e-5)
+
+
+def test_resnet50_shapes_and_feature_dim():
+    m = get_model("ResNet50")
+    variables = m.init_params(seed=0)
+    feat_fn = jax.jit(m.apply_fn(features_only=True))
+    logit_fn = jax.jit(m.apply_fn(features_only=False))
+    x = np.random.default_rng(0).uniform(0, 255, (2, 224, 224, 3)).astype(np.float32)
+    feats = feat_fn(variables, x)
+    logits = logit_fn(variables, x)
+    assert feats.shape == (2, 2048)
+    assert logits.shape == (2, 1000)
+    # deterministic across calls
+    np.testing.assert_array_equal(np.asarray(feats),
+                                  np.asarray(feat_fn(variables, x)))
+
+
+def test_inception_v3_full_size_bottleneck():
+    m = get_model("InceptionV3")
+    variables = m.init_params(seed=0)
+    fn = jax.jit(m.apply_fn(features_only=True))
+    x = np.random.default_rng(1).uniform(0, 255, (1, 299, 299, 3)).astype(np.float32)
+    feats = fn(variables, x)
+    assert feats.shape == (1, 2048)
+    assert np.isfinite(np.asarray(feats)).all()
+
+
+def test_param_counts_sane():
+    # ResNet50 ≈ 25.6M params; InceptionV3 ≈ 23.9M (with heads).
+    def count(vs):
+        return sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(vs["params"]))
+
+    rn = count(get_model("ResNet50").init_params())
+    assert 25_000_000 < rn < 26_500_000, rn
+    iv = count(get_model("InceptionV3").init_params())
+    assert 23_000_000 < iv < 24_500_000, iv
+
+
+def test_bf16_compute_fp32_params():
+    m = get_model("ResNet18")
+    variables = m.init_params(seed=0, dtype=jnp.bfloat16)
+    p0 = jax.tree_util.tree_leaves(variables["params"])[0]
+    assert p0.dtype == jnp.float32  # params stay fp32
+    fn = jax.jit(m.apply_fn(dtype=jnp.bfloat16, features_only=True))
+    x = np.zeros((1, 224, 224, 3), np.float32)
+    out = fn(variables, x)
+    assert out.dtype == jnp.float32  # features cast back at the boundary
+    assert out.shape == (1, 512)
+
+
+def test_weight_roundtrip_msgpack_and_safetensors(tmp_path):
+    m = get_model("ResNet18")
+    variables = m.init_params(seed=42)
+    p1 = str(tmp_path / "w.msgpack")
+    registry.save_weights(variables, p1)
+    loaded = registry.load_weights(variables, p1)
+    np.testing.assert_array_equal(
+        np.asarray(jax.tree_util.tree_leaves(loaded)[0]),
+        np.asarray(jax.tree_util.tree_leaves(variables)[0]))
+
+    p2 = str(tmp_path / "w.safetensors")
+    registry.save_safetensors(variables, p2)
+    loaded2 = registry.load_safetensors(variables, p2)
+    for a, b in zip(jax.tree_util.tree_leaves(loaded2),
+                    jax.tree_util.tree_leaves(variables)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    registry.save_safetensors({"params": {"w": jnp.ones((2,))}},
+                              str(tmp_path / "bad.safetensors"))
+    with pytest.raises(ValueError, match="missing"):
+        registry.load_safetensors(variables, str(tmp_path / "bad.safetensors"))
+
+
+def test_decode_predictions():
+    logits = np.array([[0.0, 3.0, 1.0]])
+    out = registry.decodePredictions(logits, top=2)
+    assert out[0][0]["class"] == 1 and out[0][1]["class"] == 2
+    assert 0 < out[0][0]["score"] <= 1
+    assert out[0][0]["label"] == "class_1"

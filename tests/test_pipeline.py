@@ -180,3 +180,24 @@ def test_load_fails_loudly_on_unrestored_payload_params(tmp_path):
     t.save(p)
     with pytest.raises(ValueError, match="fn"):
         MLWritable.load(p)
+
+
+def test_pipeline_propagates_stage_params():
+    # Spark contract: fit(df, params={stage.param: v}) reaches the stage.
+    add = AddConst(inputCol="v", outputCol="a", amount=1.0)
+    pipe = Pipeline(stages=[add])
+    pm = pipe.fit(data(), params={add.amount: 10.0})
+    assert [r.a for r in pm.transform(data()).collect()] == \
+        [11.0, 12.0, 13.0, 14.0]
+    assert add.getOrDefault("amount") == 1.0  # original untouched
+
+    # PipelineModel.transform(df, params={stage.param: v}) too
+    pm2 = Pipeline(stages=[add]).fit(data())
+    out = pm2.transform(data(), params={add.amount: 5.0})
+    assert [r.a for r in out.collect()] == [6.0, 7.0, 8.0, 9.0]
+
+
+def test_copy_ignores_foreign_params():
+    a, b = AddConst(), AddConst()
+    c = a.copy({b.amount: 9.0})  # foreign param silently ignored (Spark)
+    assert not c.isSet(c.amount)

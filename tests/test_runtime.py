@@ -1,0 +1,97 @@
+"""Runtime tests: mesh construction, padding, prefetch pipeline, BatchRunner."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparkdl_tpu.core import runtime
+
+
+def test_make_mesh_default_and_2d():
+    m = runtime.make_mesh()
+    assert m.axis_names == ("data",)
+    assert m.devices.size == 8
+
+    m2 = runtime.make_mesh({"data": 4, "model": 2})
+    assert m2.axis_names == ("data", "model")
+    assert m2.devices.shape == (4, 2)
+
+    m3 = runtime.make_mesh({"data": -1, "model": 2})
+    assert m3.devices.shape == (4, 2)
+
+    with pytest.raises(ValueError):
+        runtime.make_mesh({"data": 3})
+    with pytest.raises(ValueError):
+        runtime.make_mesh({"data": -1, "model": -1})
+
+
+def test_pad_batch():
+    x = np.ones((3, 4), np.float32)
+    padded, n = runtime.pad_batch(x, 8)
+    assert padded.shape == (8, 4) and n == 3
+    np.testing.assert_array_equal(padded[3:], np.ones((5, 4)))
+
+    d, n = runtime.pad_batch({"a": x, "b": np.zeros((3,))}, 4)
+    assert d["a"].shape == (4, 4) and d["b"].shape == (4,) and n == 3
+
+    same, n = runtime.pad_batch(x, 3)
+    assert n == 3 and same.shape == (3, 4)
+
+    with pytest.raises(ValueError):
+        runtime.pad_batch(x, 2)
+
+
+def test_prefetch_to_device_preserves_order_and_content():
+    batches = [np.full((2, 2), i, np.float32) for i in range(7)]
+    out = list(runtime.prefetch_to_device(iter(batches), size=3))
+    assert len(out) == 7
+    for i, b in enumerate(out):
+        assert isinstance(b, jax.Array)
+        np.testing.assert_array_equal(np.asarray(b), batches[i])
+
+
+def test_prefetch_sharded_across_mesh():
+    mesh = runtime.make_mesh()
+    sharding = runtime.data_sharding(mesh)
+    batches = [np.arange(16, dtype=np.float32).reshape(8, 2)]
+    (dev_b,) = list(runtime.prefetch_to_device(iter(batches), sharding=sharding))
+    assert len(dev_b.sharding.device_set) == 8
+    np.testing.assert_array_equal(np.asarray(dev_b), batches[0])
+
+
+def test_batch_runner_pads_runs_unpads():
+    traces = []
+
+    def fn(x):
+        traces.append(x.shape)
+        return x * 2.0
+
+    runner = runtime.BatchRunner(fn, batch_size=4)
+    batches = [np.ones((4, 3), np.float32), np.ones((4, 3), np.float32),
+               np.ones((2, 3), np.float32)]  # ragged tail
+    outs = list(runner.run(iter(batches)))
+    assert [o.shape for o in outs] == [(4, 3), (4, 3), (2, 3)]
+    np.testing.assert_allclose(outs[2], 2.0)
+    # one trace only: static shape held across full and padded batches
+    assert traces == [(4, 3)]
+
+
+def test_batch_runner_dict_batches():
+    def fn(d):
+        return {"s": d["a"] + d["b"]}
+
+    runner = runtime.BatchRunner(fn, batch_size=4)
+    out = next(iter(runner.run([{"a": np.ones((3, 2), np.float32),
+                                 "b": np.ones((3, 2), np.float32)}])))
+    assert out["s"].shape == (3, 2)
+    np.testing.assert_allclose(out["s"], 2.0)
+
+
+def test_compile_cache_counts():
+    cache = runtime.CompileCache()
+    f = cache.get("f", lambda x: x + 1)
+    f(jnp.ones((2,)))
+    f(jnp.ones((2,)))
+    f(jnp.ones((3,)))
+    assert cache.misses == 2 and cache.hits == 1
