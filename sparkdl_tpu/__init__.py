@@ -18,6 +18,14 @@ from .core import (DataFrame, Estimator, Evaluator, HasBatchSize, HasInputCol,
                    HasLabelCol, HasOutputCol, HasPredictionCol, HasSeed,
                    MLWritable, Model, Param, Params, Pipeline, PipelineModel,
                    Row, Transformer, TypeConverters, keyword_only, load)
+from .estimators import LogisticRegression, LogisticRegressionModel
+from .image.imageIO import imageSchema, readImages, readImagesWithCustomFn
+from .transformers import (DeepImageFeaturizer, DeepImagePredictor,
+                           KerasImageFileTransformer, KerasTransformer,
+                           TFImageTransformer, TFTransformer,
+                           XlaImageTransformer, XlaTransformer)
+from .udf import (applyUDF, listUDFs, registerImageUDF, registerKerasImageUDF,
+                  registerUDF)
 
 __all__ = [
     "DataFrame", "Row",
@@ -26,5 +34,13 @@ __all__ = [
     "HasBatchSize", "HasSeed",
     "Transformer", "Estimator", "Model", "Evaluator",
     "Pipeline", "PipelineModel", "MLWritable", "load",
+    "imageSchema", "readImages", "readImagesWithCustomFn",
+    "XlaImageTransformer", "TFImageTransformer",
+    "DeepImageFeaturizer", "DeepImagePredictor",
+    "KerasImageFileTransformer", "XlaTransformer", "TFTransformer",
+    "KerasTransformer",
+    "LogisticRegression", "LogisticRegressionModel",
+    "registerUDF", "registerImageUDF", "registerKerasImageUDF", "applyUDF",
+    "listUDFs",
     "__version__",
 ]

@@ -217,9 +217,15 @@ class DataFrame:
 
     def toArrow(self) -> pa.Table:
         batches = [b for b in self.iterPartitions()]
-        if not batches:
-            return pa.table({})
-        return pa.Table.from_batches(batches)
+        # Zero-row batches can carry degenerate column types (an op cannot
+        # infer its output type from no rows); they contribute nothing, so
+        # drop them whenever a non-empty batch fixes the schema.
+        nonempty = [b for b in batches if b.num_rows]
+        if nonempty:
+            return pa.Table.from_batches(nonempty)
+        if batches:
+            return pa.Table.from_batches(batches[:1])
+        return pa.table({})
 
     def toPandas(self) -> pd.DataFrame:
         return self.toArrow().to_pandas()

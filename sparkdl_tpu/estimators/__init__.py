@@ -1,0 +1,3 @@
+from .logistic_regression import LogisticRegression, LogisticRegressionModel
+
+__all__ = ["LogisticRegression", "LogisticRegressionModel"]

@@ -1,0 +1,17 @@
+from .keras_image import KerasImageFileTransformer, defaultImageLoader
+from .named_image import DeepImageFeaturizer, DeepImagePredictor
+from .tensor import KerasTransformer, XlaTransformer
+from .xla_image import XlaImageTransformer
+
+# Reference-name alias: the reference's TFImageTransformer applied an
+# arbitrary compute graph to an image column; XlaImageTransformer is that
+# role with jittable functions instead of TF graphs.
+TFImageTransformer = XlaImageTransformer
+TFTransformer = XlaTransformer
+
+__all__ = [
+    "XlaImageTransformer", "TFImageTransformer",
+    "DeepImageFeaturizer", "DeepImagePredictor",
+    "KerasImageFileTransformer", "defaultImageLoader",
+    "XlaTransformer", "TFTransformer", "KerasTransformer",
+]

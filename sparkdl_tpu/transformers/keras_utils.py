@@ -1,0 +1,43 @@
+"""Keras-3-on-JAX bridge: load a saved Keras model as a jittable pure function.
+
+The reference executed Keras models by exporting the TF session graph
+(``GraphFunction.fromKeras`` — SURVEY.md §2.1 graph builder). Here Keras 3
+runs natively on the JAX backend: ``stateless_call`` gives a pure
+``(variables, x) → y`` that jit-compiles for TPU like any flax apply.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def _keras():
+    os.environ.setdefault("KERAS_BACKEND", "jax")
+    import keras
+    if keras.backend.backend() != "jax":
+        raise RuntimeError(
+            "Keras must run on the JAX backend for TPU execution; set "
+            "KERAS_BACKEND=jax before importing keras (current: "
+            f"{keras.backend.backend()!r})")
+    return keras
+
+
+def load_keras_model(model_file: str):
+    return _keras().models.load_model(model_file, compile=False)
+
+
+def keras_model_to_fn(model):
+    """Keras model → jittable ``fn(batch)`` closing over its weights."""
+    trainable = [v.value for v in model.trainable_variables]
+    non_trainable = [v.value for v in model.non_trainable_variables]
+
+    def fn(batch):
+        out, _ = model.stateless_call(trainable, non_trainable, batch,
+                                      training=False)
+        return out
+
+    return fn
+
+
+def keras_file_to_fn(model_file: str):
+    return keras_model_to_fn(load_keras_model(model_file))

@@ -1,0 +1,169 @@
+"""DeepImageFeaturizer / DeepImagePredictor — named pretrained-model transformers.
+
+The flagship transfer-learning surface (reference:
+``python/sparkdl/transformers/named_image.py``, SURVEY.md §2.1/§3.1):
+``DeepImageFeaturizer(modelName=...)`` emits the model's bottleneck features
+for downstream shallow learners; ``DeepImagePredictor`` emits (optionally
+decoded) class predictions.
+
+TPU-native shape: model lookup in :mod:`sparkdl_tpu.models.registry`, weights
+as a flax pytree, and the whole resize→preprocess→truncated-model graph
+compiled as ONE ``jax.jit`` program (the reference stitched TF graph pieces
+and ran them via TensorFrames JNI). Zero-egress environment: weights are
+seeded-random by default; ``weightsPath`` loads locally-provided msgpack/
+safetensors weights.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..core.params import (HasSeed, Param, Params, TypeConverters,
+                           keyword_only)
+from ..models import registry as model_registry
+from .xla_image import XlaImageTransformer
+
+
+class _NamedImageTransformer(XlaImageTransformer, HasSeed):
+    """Shared machinery: resolve modelName → (module, params, apply fn)."""
+
+    modelName = Param(Params, "modelName",
+                      "named model from SUPPORTED_MODELS",
+                      TypeConverters.toString)
+    weightsPath = Param(Params, "weightsPath",
+                        "local msgpack/safetensors weights file; random "
+                        "seeded init when unset (zero-egress environment)",
+                        TypeConverters.toString)
+
+    _features_only = True
+
+    def __init__(self):
+        super(XlaImageTransformer, self).__init__()
+        self._setDefault(batchSize=32, channelOrder="RGB",
+                         outputMode="vector", inputCol="image", seed=0)
+        self._variables = None
+
+    def getModelName(self) -> str:
+        return self.getOrDefault(self.modelName)
+
+    def _model(self) -> model_registry.NamedImageModel:
+        return model_registry.get_model(self.getModelName())
+
+    def _load_variables(self):
+        # getattr: instances revived by MLWritable.load bypass __init__.
+        if getattr(self, "_variables", None) is None:
+            m = self._model()
+            variables = m.init_params(seed=self.getOrDefault(self.seed))
+            if self.isDefined(self.weightsPath):
+                path = self.getOrDefault(self.weightsPath)
+                if path.endswith(".safetensors"):
+                    variables = model_registry.load_safetensors(variables, path)
+                else:
+                    variables = model_registry.load_weights(variables, path)
+            self._variables = variables
+        return self._variables
+
+    def setWeights(self, variables):
+        """Directly install a flax variables pytree (e.g. a fine-tuned one)."""
+        self._variables = variables
+        return self
+
+    def _make_fn(self):
+        m = self._model()
+        variables = self._load_variables()
+        apply = m.apply_fn(features_only=self._features_only)
+        return lambda batch: apply(variables, batch)
+
+    def _runner_key(self) -> tuple:
+        return (self.getBatchSize(), self.getModelName(),
+                self._features_only, id(self._load_variables()))
+
+    def _transform(self, dataset):
+        # Pin the static input size from the model registry before the
+        # generic image path runs.
+        m = self._model()
+        self._set(inputSize=m.input_size)
+        return super()._transform(dataset)
+
+    def _save_payload(self, path: str):
+        if getattr(self, "_variables", None) is not None:
+            model_registry.save_weights(self._variables,
+                                        os.path.join(path, "weights.msgpack"))
+
+    def _load_payload(self, path: str, meta: dict):
+        self._variables = None
+        wpath = os.path.join(path, "weights.msgpack")
+        if os.path.exists(wpath):
+            template = self._model().init_params(
+                seed=self.getOrDefault(self.seed))
+            self._variables = model_registry.load_weights(template, wpath)
+
+
+class DeepImageFeaturizer(_NamedImageTransformer):
+    """Bottleneck-feature extractor for transfer learning (BASELINE config 1:
+    ``Pipeline([DeepImageFeaturizer(InceptionV3), LogisticRegression])``)."""
+
+    _features_only = True
+
+    @keyword_only
+    def __init__(self, inputCol=None, outputCol=None, modelName=None,
+                 batchSize=None, weightsPath=None, seed=None):
+        super().__init__()
+        self._set(**self._input_kwargs)
+
+    @keyword_only
+    def setParams(self, inputCol=None, outputCol=None, modelName=None,
+                  batchSize=None, weightsPath=None, seed=None):
+        return self._set(**self._input_kwargs)
+
+    def featureDim(self) -> int:
+        return self._model().feature_dim
+
+
+class DeepImagePredictor(_NamedImageTransformer):
+    """Full-model classifier. ``decodePredictions=True`` emits a struct column
+    of top-K {class, label, score} like the reference's decoded output."""
+
+    _features_only = False
+
+    decodePredictions = Param(Params, "decodePredictions",
+                              "emit top-K decoded predictions instead of "
+                              "raw logits", TypeConverters.toBoolean)
+    topK = Param(Params, "topK", "K for decoded predictions",
+                 TypeConverters.toInt)
+
+    @keyword_only
+    def __init__(self, inputCol=None, outputCol=None, modelName=None,
+                 batchSize=None, weightsPath=None, seed=None,
+                 decodePredictions=None, topK=None):
+        super().__init__()
+        self._setDefault(decodePredictions=False, topK=5)
+        self._set(**self._input_kwargs)
+
+    @keyword_only
+    def setParams(self, inputCol=None, outputCol=None, modelName=None,
+                  batchSize=None, weightsPath=None, seed=None,
+                  decodePredictions=None, topK=None):
+        return self._set(**self._input_kwargs)
+
+    def _transform(self, dataset):
+        out = super()._transform(dataset)
+        if not self.getOrDefault(self.decodePredictions):
+            return out
+        import numpy as np
+        import pyarrow as pa
+
+        from ..core.frame import _length_preserving, _set_column
+        out_col = self.getOutputCol()
+        top = self.getOrDefault(self.topK)
+
+        def decode_op(batch: pa.RecordBatch) -> pa.RecordBatch:
+            logits = np.asarray(batch.column(out_col).to_pylist(),
+                                dtype=np.float32)
+            decoded = model_registry.decodePredictions(logits, top=top)
+            typ = pa.list_(pa.struct([("class", pa.int32()),
+                                      ("label", pa.string()),
+                                      ("score", pa.float32())]))
+            return _set_column(batch, out_col, pa.array(decoded, type=typ))
+
+        return out.mapBatches(_length_preserving(decode_op))

@@ -1,0 +1,29 @@
+"""Shared persistence helper: params whose values are callables.
+
+Callable params (jittable fns, image loaders) can't go in metadata.json;
+subclasses list them in ``_pickled_params`` and this mixin cloudpickles each
+set value into ``<name>.pkl`` beside the stage metadata.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+class PicklesCallableParams:
+    _pickled_params: tuple[str, ...] = ()
+
+    def _save_payload(self, path: str):
+        import cloudpickle
+        for name in self._pickled_params:
+            if self.isSet(name):
+                with open(os.path.join(path, f"{name}.pkl"), "wb") as f:
+                    cloudpickle.dump(self.getOrDefault(name), f)
+
+    def _load_payload(self, path: str, meta: dict):
+        import cloudpickle
+        for name in self._pickled_params:
+            fpath = os.path.join(path, f"{name}.pkl")
+            if os.path.exists(fpath):
+                with open(fpath, "rb") as f:
+                    self._set(**{name: cloudpickle.load(f)})

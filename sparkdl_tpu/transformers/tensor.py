@@ -1,0 +1,133 @@
+"""XlaTransformer / KerasTransformer — jitted functions over numeric columns.
+
+Reference: ``transformers/tf_tensor.py`` (``TFTransformer``) and
+``transformers/keras_tensor.py`` (``KerasTransformer``) — SURVEY.md §2.1:
+apply a TF graph / saved Keras model to array columns. Here the graph is any
+jittable function (or a Keras-3-on-JAX model file) and execution is the same
+pad/prefetch/jit BatchRunner pipeline the image transformers use.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+
+from ..core.frame import DataFrame, _length_preserving, _set_column
+from ..core.params import (HasBatchSize, HasInputCol, HasOutputCol, Param,
+                           Params, TypeConverters, keyword_only)
+from ..core.pipeline import Transformer
+from ..core.runtime import BatchRunner
+from .keras_utils import keras_file_to_fn
+from .payloads import PicklesCallableParams
+from .xla_image import arrayColumnToArrow
+
+
+def columnToNdarray(column: pa.Array, shape: tuple | None,
+                    dtype=np.float32) -> np.ndarray:
+    """list<float> / primitive column → (N, *shape) contiguous array."""
+    if isinstance(column, pa.ChunkedArray):
+        column = column.combine_chunks()
+    if pa.types.is_list(column.type) or pa.types.is_fixed_size_list(column.type):
+        flat = column.flatten().to_numpy(zero_copy_only=False).astype(dtype)
+        n = len(column)
+        if shape:
+            return np.ascontiguousarray(flat.reshape((n,) + tuple(shape)))
+        if n and flat.size % n:
+            raise ValueError(f"Ragged array column: {flat.size} values over "
+                             f"{n} rows")
+        return np.ascontiguousarray(flat.reshape(n, -1) if n else
+                                    flat.reshape(0, 0))
+    arr = column.to_numpy(zero_copy_only=False).astype(dtype)
+    return arr.reshape((len(arr),) + tuple(shape)) if shape else arr
+
+
+class XlaTransformer(PicklesCallableParams, Transformer, HasInputCol,
+                     HasOutputCol, HasBatchSize):
+    """Applies a jittable ``fn(batch)`` to a numeric array column (the
+    TFTransformer analogue)."""
+
+    fn = Param(Params, "fn", "jittable function over (N, ...) float batches",
+               TypeConverters.toCallable)
+    inputShape = Param(Params, "inputShape",
+                       "per-row shape to reshape flat list columns to "
+                       "(optional; flat rows default to (N, D))",
+                       TypeConverters.toShape)
+
+    @keyword_only
+    def __init__(self, inputCol=None, outputCol=None, fn=None,
+                 inputShape=None, batchSize=None):
+        super().__init__()
+        self._setDefault(batchSize=64)
+        self._set(**self._input_kwargs)
+
+    @keyword_only
+    def setParams(self, inputCol=None, outputCol=None, fn=None,
+                  inputShape=None, batchSize=None):
+        return self._set(**self._input_kwargs)
+
+    def _make_fn(self):
+        return self.getOrDefault(self.fn)
+
+    def _runner_key(self) -> tuple:
+        return (self.getBatchSize(), id(self._paramMap.get(self.fn)))
+
+    def _get_runner(self) -> BatchRunner:
+        key = self._runner_key()
+        cached = getattr(self, "_runner_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        runner = BatchRunner(self._make_fn(), self.getBatchSize())
+        self._runner_cache = (key, runner)
+        return runner
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        in_col = self.getInputCol()
+        out_col = self.getOutputCol()
+        batch_size = self.getBatchSize()
+        shape = (self.getOrDefault(self.inputShape)
+                 if self.isDefined(self.inputShape) else None)
+        runner = self._get_runner()
+
+        def op(batch: pa.RecordBatch) -> pa.RecordBatch:
+            from .xla_image import emptyVectorColumn
+            if batch.num_rows == 0:
+                return _set_column(batch, out_col, emptyVectorColumn())
+            arr = columnToNdarray(batch.column(in_col), shape)
+            outs = list(runner.run(
+                arr[i:i + batch_size]
+                for i in range(0, len(arr), batch_size)))
+            result = np.concatenate([np.asarray(o) for o in outs], axis=0)
+            return _set_column(batch, out_col, arrayColumnToArrow(result))
+
+        return dataset.mapBatches(_length_preserving(op))
+
+    _pickled_params = ("fn",)
+
+
+class KerasTransformer(XlaTransformer):
+    """Applies a saved Keras model (Keras-3-on-JAX) to a 1-D array column —
+    the reference's KerasTransformer (single input/output tensor contract)."""
+
+    modelFile = Param(Params, "modelFile",
+                      "path to a saved Keras model (.keras/.h5)",
+                      TypeConverters.toString)
+
+    @keyword_only
+    def __init__(self, inputCol=None, outputCol=None, modelFile=None,
+                 inputShape=None, batchSize=None):
+        super(XlaTransformer, self).__init__()
+        self._setDefault(batchSize=64)
+        self._set(**self._input_kwargs)
+
+    @keyword_only
+    def setParams(self, inputCol=None, outputCol=None, modelFile=None,
+                  inputShape=None, batchSize=None):
+        return self._set(**self._input_kwargs)
+
+    def _make_fn(self):
+        return keras_file_to_fn(self.getOrDefault(self.modelFile))
+
+    def _runner_key(self) -> tuple:
+        return (self.getBatchSize(), self.getOrDefault(self.modelFile))
+
+    _pickled_params = ()

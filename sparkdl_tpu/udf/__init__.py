@@ -1,0 +1,5 @@
+from .registry import (applyUDF, listUDFs, registerImageUDF,
+                       registerKerasImageUDF, registerUDF, unregisterUDF)
+
+__all__ = ["registerUDF", "registerImageUDF", "registerKerasImageUDF",
+           "applyUDF", "listUDFs", "unregisterUDF"]

@@ -1,0 +1,102 @@
+"""UDF registry — named, reusable column functions over DataFrames.
+
+Reference surface: ``registerKerasImageUDF(name, model, preprocessor)``
+(``python/sparkdl/udf/keras_image_model.py``) + ``makeGraphUDF``
+(``graph/tensorframes_udf.py``) registered TF graphs as Spark SQL UDFs
+executed by TensorFrames in the JVM (SURVEY.md §2.1/§3.3). There is no JVM
+and no SQL parser here; the equivalent contract is a process-global registry
+of named batch functions applicable to any DataFrame column via
+``applyUDF(df, name, inputCol, outputCol)`` — the same "register once, score
+anywhere by name" workflow, executing as jitted XLA programs.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from ..core.frame import DataFrame
+
+_UDF_REGISTRY: dict[str, Callable[[DataFrame, str, str], DataFrame]] = {}
+
+
+def registerUDF(name: str, fn: Callable, batchSize: int = 64,
+                inputShape: tuple | None = None) -> None:
+    """Register a jittable ``fn(batch)`` over numeric array columns."""
+    from ..transformers.tensor import XlaTransformer
+
+    def apply(df: DataFrame, inputCol: str, outputCol: str) -> DataFrame:
+        t = XlaTransformer(inputCol=inputCol, outputCol=outputCol, fn=fn,
+                           batchSize=batchSize,
+                           **({"inputShape": inputShape} if inputShape else {}))
+        return t.transform(df)
+
+    _UDF_REGISTRY[name] = apply
+
+
+def registerImageUDF(name: str, fn: Callable, inputSize: tuple[int, int],
+                     batchSize: int = 32, channelOrder: str = "RGB") -> None:
+    """Register a jittable ``fn(nhwc_batch)`` over image-struct columns."""
+    from ..transformers.xla_image import XlaImageTransformer
+
+    def apply(df: DataFrame, inputCol: str, outputCol: str) -> DataFrame:
+        t = XlaImageTransformer(inputCol=inputCol, outputCol=outputCol, fn=fn,
+                                inputSize=inputSize, batchSize=batchSize,
+                                channelOrder=channelOrder)
+        return t.transform(df)
+
+    _UDF_REGISTRY[name] = apply
+
+
+def registerKerasImageUDF(udf_name: str, keras_model_or_file,
+                          preprocessor: Callable | None = None,
+                          batchSize: int = 32) -> None:
+    """The reference's flagship UDF: compose image-decode ∘ (preprocessor) ∘
+    Keras model and register under ``udf_name``.
+
+    ``keras_model_or_file``: a Keras-3 model object, a saved-model path, or a
+    named model from SUPPORTED_MODELS (e.g. "InceptionV3" — random-init in
+    this zero-egress environment). ``preprocessor`` is a jittable NHWC→NHWC
+    function fused in front of the model inside the same XLA program.
+    """
+    from ..transformers.keras_utils import keras_model_to_fn
+
+    if isinstance(keras_model_or_file, str):
+        from ..models import SUPPORTED_MODELS, get_model
+        if keras_model_or_file in SUPPORTED_MODELS:
+            m = get_model(keras_model_or_file)
+            variables = m.init_params()
+            apply_model = m.apply_fn(features_only=False)
+            base_fn = lambda b: apply_model(variables, b)
+            input_hw = m.input_size
+        else:
+            from ..transformers.keras_utils import load_keras_model
+            model = load_keras_model(keras_model_or_file)
+            base_fn = keras_model_to_fn(model)
+            shape = model.inputs[0].shape
+            input_hw = (int(shape[1]), int(shape[2]))
+    else:
+        base_fn = keras_model_to_fn(keras_model_or_file)
+        shape = keras_model_or_file.inputs[0].shape
+        input_hw = (int(shape[1]), int(shape[2]))
+
+    fn = (lambda b: base_fn(preprocessor(b))) if preprocessor else base_fn
+    registerImageUDF(udf_name, fn, inputSize=input_hw, batchSize=batchSize)
+
+
+
+def applyUDF(df: DataFrame, name: str, inputCol: str,
+             outputCol: str) -> DataFrame:
+    try:
+        apply = _UDF_REGISTRY[name]
+    except KeyError:
+        raise ValueError(f"UDF {name!r} is not registered; available: "
+                         f"{sorted(_UDF_REGISTRY)}") from None
+    return apply(df, inputCol, outputCol)
+
+
+def listUDFs() -> list[str]:
+    return sorted(_UDF_REGISTRY)
+
+
+def unregisterUDF(name: str) -> None:
+    _UDF_REGISTRY.pop(name, None)
