@@ -24,6 +24,8 @@ from .transformers import (DeepImageFeaturizer, DeepImagePredictor,
                            KerasImageFileTransformer, KerasTransformer,
                            TFImageTransformer, TFTransformer,
                            XlaImageTransformer, XlaTransformer)
+from .runner import (CheckpointManager, RunnerContext, TrainState, XlaRunner,
+                     make_shard_map_step, make_train_step)
 from .udf import (applyUDF, listUDFs, registerImageUDF, registerKerasImageUDF,
                   registerUDF)
 
@@ -42,5 +44,7 @@ __all__ = [
     "LogisticRegression", "LogisticRegressionModel",
     "registerUDF", "registerImageUDF", "registerKerasImageUDF", "applyUDF",
     "listUDFs",
+    "XlaRunner", "RunnerContext", "TrainState", "CheckpointManager",
+    "make_train_step", "make_shard_map_step",
     "__version__",
 ]

@@ -1,0 +1,85 @@
+"""Horovod-style module API for migration (``import sparkdl_tpu.runner.api as
+hvd``).
+
+The reference's user training scripts were written against ``horovod.tensorflow``:
+``hvd.init(); hvd.rank(); hvd.size(); hvd.allreduce(t)`` (SURVEY.md §3.5).
+This shim maps each call to its mesh-native meaning so such scripts port
+mechanically. New code should use :class:`RunnerContext` directly — these
+functions are a compatibility veneer over it.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .xla_runner import RunnerContext, XlaRunner, current_context
+
+_default_runner: XlaRunner | None = None
+
+
+def init(np: int = -1, **kwargs) -> RunnerContext:
+    """hvd.init() — establish a mesh context for subsequent calls. Outside an
+    ``XlaRunner.run``, creates (and caches) a default all-device runner."""
+    global _default_runner
+    ctx = current_context()
+    if ctx is not None:
+        return ctx
+    _default_runner = XlaRunner(np=np, **kwargs)
+    ctx = _default_runner.make_context()
+    from . import xla_runner
+    xla_runner._CURRENT_CONTEXT.append(ctx)
+    return ctx
+
+
+def _ctx() -> RunnerContext:
+    ctx = current_context()
+    if ctx is None:
+        raise RuntimeError("call runner.api.init() first (hvd.init analogue)")
+    return ctx
+
+
+def size() -> int:
+    return _ctx().size
+
+
+def rank() -> int:
+    return _ctx().rank
+
+
+def local_rank() -> int:
+    return 0  # single-controller: the process owns all its local devices
+
+
+def shutdown():
+    from . import xla_runner
+    if xla_runner._CURRENT_CONTEXT:
+        xla_runner._CURRENT_CONTEXT.pop()
+
+
+def allreduce(x, average: bool = True):
+    """Eager allreduce over the data axis — for out-of-step reductions
+    (metric aggregation). In-step gradient reduction should NOT use this; it
+    is compiled into the train step (see train_state.py)."""
+    ctx = _ctx()
+    n = ctx.size
+    arr = jnp.asarray(x)
+    # A replicated-in, replicated-out sum over the sharded value: express as
+    # a jit over the mesh so XLA lowers it to one collective.
+    sh = ctx.data_sharding()
+
+    @jax.jit
+    def _sum(v):
+        return v.sum(axis=0)
+
+    stacked = jax.device_put(
+        jnp.broadcast_to(arr[None], (n,) + arr.shape), sh)
+    out = _sum(stacked)
+    return out / n if average else out
+
+
+def broadcast(x, root_rank: int = 0):
+    """hvd.broadcast — trivial under a single controller: the value is already
+    globally consistent; returns it replicated over the mesh."""
+    ctx = _ctx()
+    return jax.device_put(jnp.asarray(x), ctx.replicated())

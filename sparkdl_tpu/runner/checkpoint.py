@@ -1,0 +1,102 @@
+"""Checkpoint/resume for the runner (SURVEY.md §5.3–5.4).
+
+Reference semantics: Keras HDF5 save/load + Spark ML persistence; failure
+recovery = re-run the job (Horovod jobs fail whole, Spark retries tasks).
+TPU-native: orbax-checkpoint — async, sharded-array-aware saves of the full
+``TrainState`` pytree, with ``latest_step``/``restore`` for
+checkpoint-and-restart recovery. No elastic resize (matches reference
+semantics: a failed run resumes from the last checkpoint at the same scale).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+
+
+class CheckpointManager:
+    """Thin orbax wrapper pinned to the runner's needs.
+
+    Saves ``{params, opt_state, step}`` (the array leaves of a TrainState —
+    the static apply_fn/tx are reconstructed by the caller, exactly as the
+    reference rebuilt the Keras model and loaded HDF5 weights into it).
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 async_save: bool = True):
+        import orbax.checkpoint as ocp
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        opts = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep, enable_async_checkpointing=async_save)
+        self._mngr = ocp.CheckpointManager(self.directory, options=opts)
+
+    def save(self, step: int, state: Any, wait: bool = False):
+        import orbax.checkpoint as ocp
+        payload = {
+            "params": state.params,
+            "opt_state": state.opt_state,
+            "step": state.step,
+        }
+        self._mngr.save(step, args=ocp.args.StandardSave(payload))
+        if wait:
+            self._mngr.wait_until_finished()
+
+    def latest_step(self) -> int | None:
+        return self._mngr.latest_step()
+
+    def restore(self, state_template: Any, step: int | None = None) -> Any:
+        """Restore into the shape/sharding of ``state_template`` (a freshly
+        created TrainState); returns the template with restored leaves."""
+        import dataclasses
+
+        import orbax.checkpoint as ocp
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"No checkpoint in {self.directory}")
+        template = {
+            "params": state_template.params,
+            "opt_state": state_template.opt_state,
+            "step": state_template.step,
+        }
+        restored = self._mngr.restore(
+            step, args=ocp.args.StandardRestore(template))
+        return dataclasses.replace(
+            state_template, params=restored["params"],
+            opt_state=restored["opt_state"], step=restored["step"])
+
+    def wait(self):
+        self._mngr.wait_until_finished()
+
+    def close(self):
+        self._mngr.close()
+
+
+def save_portable(params: Any, path: str):
+    """Portable single-file weight export (safetensors) — the analogue of the
+    reference's HDF5 ``modelFile`` artifacts, importable anywhere."""
+    from flax.traverse_util import flatten_dict
+    from safetensors.numpy import save_file
+    import numpy as np
+    flat = flatten_dict(params, sep="/")
+    save_file({k: np.asarray(v) for k, v in flat.items()}, path)
+
+
+def load_portable(params_template: Any, path: str) -> Any:
+    from flax.traverse_util import flatten_dict, unflatten_dict
+    from safetensors.numpy import load_file
+    import jax.numpy as jnp
+    loaded = load_file(path)
+    flat = flatten_dict(params_template, sep="/")
+    out = {}
+    for k, tmpl in flat.items():
+        if k not in loaded:
+            raise ValueError(f"missing key {k} in {path}")
+        arr = jnp.asarray(loaded[k])
+        if arr.shape != tmpl.shape:
+            raise ValueError(f"shape mismatch for {k}: {arr.shape} vs "
+                             f"{tmpl.shape}")
+        out[tuple(k.split("/"))] = arr
+    return unflatten_dict(out)

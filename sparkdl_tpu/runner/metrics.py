@@ -1,0 +1,138 @@
+"""Runner observability: throughput metering, step tracing, debug modes.
+
+The reference had no in-tree profiling — users got the Spark UI's stage/task
+timing (SURVEY.md §5.1). Here per-step examples/s/chip is a first-class runner
+output (it is *the* BASELINE metric), and ``jax.profiler`` traces are one
+call away.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import time
+from dataclasses import dataclass, field
+
+import jax
+
+log = logging.getLogger("sparkdl_tpu.runner")
+
+
+@dataclass
+class ThroughputMeter:
+    """Tracks examples/s and examples/s/chip over a training run.
+
+    ``update(n)`` per step after the step's results are *ready* (the caller
+    controls ``block_until_ready`` discipline — metering must not force extra
+    host syncs on the hot path, so by default only every ``sync_every`` steps
+    block).
+    """
+    n_chips: int = 1
+    warmup_steps: int = 1  # first step includes XLA compile; exclude it
+    _t0: float | None = None
+    _steps: int = 0
+    _examples: int = 0
+    _window: list = field(default_factory=list)
+
+    def update(self, n_examples: int):
+        now = time.perf_counter()
+        self._steps += 1
+        if self._steps <= self.warmup_steps:
+            self._t0 = now
+            return
+        self._examples += n_examples
+        self._window.append((now, n_examples))
+        if len(self._window) > 50:
+            self._window.pop(0)
+
+    @property
+    def steps(self) -> int:
+        return self._steps
+
+    def examples_per_sec(self) -> float:
+        if self._t0 is None or self._steps <= self.warmup_steps:
+            return 0.0
+        dt = time.perf_counter() - self._t0
+        return self._examples / dt if dt > 0 else 0.0
+
+    def examples_per_sec_per_chip(self) -> float:
+        return self.examples_per_sec() / max(self.n_chips, 1)
+
+    def recent_examples_per_sec(self) -> float:
+        if len(self._window) < 2:
+            return self.examples_per_sec()
+        dt = self._window[-1][0] - self._window[0][0]
+        n = sum(n for _, n in self._window[1:])
+        return n / dt if dt > 0 else 0.0
+
+    def summary(self) -> dict:
+        return {
+            "steps": self._steps,
+            "examples": self._examples,
+            "examples_per_sec": round(self.examples_per_sec(), 2),
+            "examples_per_sec_per_chip":
+                round(self.examples_per_sec_per_chip(), 2),
+            "n_chips": self.n_chips,
+        }
+
+
+class MetricsLogger:
+    """Scalar metrics sink: stdlib logging always; TensorBoard event files
+    when a ``log_dir`` is given (via tensorboardX, SURVEY.md §5.5)."""
+
+    def __init__(self, log_dir: str | None = None, every: int = 10):
+        self.every = every
+        self._tb = None
+        if log_dir:
+            try:
+                from tensorboardX import SummaryWriter
+                self._tb = SummaryWriter(log_dir)
+            except Exception:  # tensorboardX optional
+                log.warning("tensorboardX unavailable; metrics to log only")
+
+    def log(self, step: int, metrics: dict):
+        if self._tb is not None:
+            for k, v in metrics.items():
+                try:
+                    self._tb.add_scalar(k, float(v), step)
+                except (TypeError, ValueError):
+                    pass
+        if step % self.every == 0:
+            flat = {k: (round(float(v), 5)
+                        if isinstance(v, (int, float)) or hasattr(v, "item")
+                        else v) for k, v in metrics.items()}
+            log.info("step %d %s", step, json.dumps(flat, default=str))
+
+    def close(self):
+        if self._tb is not None:
+            self._tb.close()
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Profile a region to a TensorBoard-viewable trace:
+    ``with runner.trace("/tmp/tb"): run_steps()``."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def step_annotation(step: int):
+    """Per-step trace annotation so the profiler groups ops by train step."""
+    return jax.profiler.StepTraceAnnotation("train", step_num=step)
+
+
+@contextlib.contextmanager
+def debug_mode(nans: bool = True):
+    """Debug sanitizer mode (SURVEY.md §5.2): XLA SPMD is data-race-free by
+    construction, so the TPU-relevant sanitizer is numeric — NaN checking
+    forces a recompile with NaN traps on every op."""
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", nans)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)

@@ -1,0 +1,178 @@
+"""Train state and compiled SPMD train steps.
+
+The reference's distributed-training core was Horovod's
+``DistributedOptimizer``: an *outside-the-graph* hook that intercepted
+gradients after backprop and ring-allreduced them over NCCL (SURVEY.md §3.5).
+The TPU-native inversion lives here: the gradient average is **inside** the
+compiled program — either implicitly (``make_train_step``: batch sharded over
+the ``data`` mesh axis, params replicated, XLA's SPMD partitioner inserts the
+cross-chip reduce) or explicitly (``make_shard_map_step``: ``jax.lax.pmean``
+over the mesh axis under ``shard_map`` — the literal "psum over ICI" of the
+BASELINE north star). Both produce bit-identical updates; the explicit form
+exists so collective semantics are testable and visible.
+
+Design rules (TPU/XLA):
+- one compilation per (step_fn, shapes): state/batch shapes are static.
+- donation: the old state buffer is donated to the new one, so optimizer
+  state never doubles HBM.
+- loss is computed in float32 even under bfloat16 params (mixed precision à
+  la MXU: matmuls in bf16, reductions in f32).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TrainState:
+    """Minimal functional train state (flax-style, dependency-free).
+
+    ``apply_fn`` and ``tx`` are static (not traced); params/opt_state/step are
+    the pytree leaves that flow through the compiled step.
+    """
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    apply_fn: Callable = dataclasses.field(metadata=dict(static=True))
+    tx: optax.GradientTransformation = dataclasses.field(
+        metadata=dict(static=True))
+
+    @classmethod
+    def create(cls, apply_fn: Callable, params: Any,
+               tx: optax.GradientTransformation) -> "TrainState":
+        return cls(step=jnp.zeros((), jnp.int32), params=params,
+                   opt_state=tx.init(params), apply_fn=apply_fn, tx=tx)
+
+    def apply_gradients(self, grads: Any) -> "TrainState":
+        updates, new_opt = self.tx.update(grads, self.opt_state, self.params)
+        return dataclasses.replace(
+            self, step=self.step + 1,
+            params=optax.apply_updates(self.params, updates),
+            opt_state=new_opt)
+
+
+def state_sharding(state: TrainState, mesh: Mesh,
+                   rules: Callable[[tuple, Any], P] | None = None):
+    """Sharding pytree for a TrainState: replicated by default (pure DP), or
+    per-leaf PartitionSpec via ``rules(path, leaf) -> P`` for TP/FSDP."""
+    if rules is None:
+        return jax.tree_util.tree_map(
+            lambda _: NamedSharding(mesh, P()), state)
+
+    def spec(path, leaf):
+        return NamedSharding(mesh, rules(path, leaf))
+
+    return jax.tree_util.tree_map_with_path(spec, state)
+
+
+def make_train_step(loss_fn: Callable, mesh: Mesh, data_axis: str = "data",
+                    param_rules: Callable | None = None,
+                    donate: bool = True) -> Callable:
+    """Compile an SPMD train step: ``step(state, batch) -> (state, metrics)``.
+
+    ``loss_fn(params, apply_fn, batch) -> (loss, aux_dict)``. The batch enters
+    sharded over ``data_axis``; params follow ``param_rules`` (default:
+    replicated = pure DP). The cross-chip gradient mean is inserted by XLA —
+    no explicit collective in user code.
+    """
+    def step(state: TrainState, batch):
+        def loss_wrapped(params):
+            loss, aux = loss_fn(params, state.apply_fn, batch)
+            return loss.astype(jnp.float32), aux
+
+        (loss, aux), grads = jax.value_and_grad(
+            loss_wrapped, has_aux=True)(state.params)
+        new_state = state.apply_gradients(grads)
+        metrics = dict(loss=loss, **aux)
+        return new_state, metrics
+
+    batch_sharding = NamedSharding(mesh, P(data_axis))
+    # state sharding resolved lazily at first call (needs the concrete state
+    # treedef); jax.jit handles that via in_shardings=None for the state and
+    # explicit constraint on the batch.
+    def with_constraints(state, batch):
+        batch = jax.lax.with_sharding_constraint(batch, batch_sharding)
+        return step(state, batch)
+
+    return jax.jit(with_constraints, donate_argnums=(0,) if donate else ())
+
+
+def make_shard_map_step(loss_fn: Callable, mesh: Mesh,
+                        data_axis: str = "data",
+                        donate: bool = True) -> Callable:
+    """The explicit-collective twin of ``make_train_step``.
+
+    Runs per-shard forward/backward under ``shard_map`` and averages gradients
+    with ``jax.lax.pmean`` over the mesh axis — the direct analogue of
+    Horovod's ring-allreduce, except compiled into the XLA program so the
+    collective overlaps with surrounding compute on ICI.
+    """
+    shard_map = jax.shard_map
+
+    def per_shard(state: TrainState, batch):
+        def loss_wrapped(params):
+            loss, aux = loss_fn(params, state.apply_fn, batch)
+            return loss.astype(jnp.float32), aux
+
+        (loss, aux), grads = jax.value_and_grad(
+            loss_wrapped, has_aux=True)(state.params)
+        # THE collective: gradient mean over the data axis (ICI ring).
+        grads = jax.lax.pmean(grads, axis_name=data_axis)
+        loss = jax.lax.pmean(loss, axis_name=data_axis)
+        aux = jax.lax.pmean(aux, axis_name=data_axis)
+        new_state = state.apply_gradients(grads)
+        return new_state, dict(loss=loss, **aux)
+
+    def step(state, batch):
+        batch_spec = jax.tree_util.tree_map(lambda _: P(data_axis), batch)
+        state_spec = jax.tree_util.tree_map(lambda _: P(), state)
+        return shard_map(
+            per_shard, mesh=mesh,
+            in_specs=(state_spec, batch_spec),
+            out_specs=(state_spec, P()),
+            check_vma=False)(state, batch)
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def make_eval_step(eval_fn: Callable, mesh: Mesh,
+                   data_axis: str = "data") -> Callable:
+    """Compile ``eval(state, batch) -> metrics`` with the batch sharded over
+    the data axis; metrics are reduced on device."""
+    batch_sharding = NamedSharding(mesh, P(data_axis))
+
+    def step(state: TrainState, batch):
+        batch = jax.lax.with_sharding_constraint(batch, batch_sharding)
+        return eval_fn(state.params, state.apply_fn, batch)
+
+    return jax.jit(step)
+
+
+def softmax_cross_entropy_loss(num_classes: int | None = None,
+                               label_key: str = "label",
+                               input_key: str = "image") -> Callable:
+    """Standard classification loss_fn for the runner: bf16-friendly
+    (logits upcast to f32 before the softmax reduction)."""
+
+    def loss_fn(params, apply_fn, batch):
+        logits = apply_fn(params, batch[input_key])
+        logits = logits.astype(jnp.float32)
+        labels = batch[label_key]
+        if labels.ndim == logits.ndim:  # one-hot
+            onehot = labels.astype(jnp.float32)
+        else:
+            onehot = jax.nn.one_hot(labels, logits.shape[-1])
+        loss = optax.softmax_cross_entropy(logits, onehot).mean()
+        acc = (logits.argmax(-1) == (labels if labels.ndim < logits.ndim
+                                     else labels.argmax(-1))).mean()
+        return loss, {"accuracy": acc.astype(jnp.float32)}
+
+    return loss_fn

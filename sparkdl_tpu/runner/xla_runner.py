@@ -1,0 +1,269 @@
+"""XlaRunner — the HorovodRunner replacement (SURVEY.md §3.5, §7.6).
+
+Reference behavior: ``HorovodRunner(np=N).run(main_fn, **kwargs)`` pickled
+``main_fn``, acquired N Spark executor slots in barrier mode, ``mpirun``-ed a
+Python process per slot, and let Horovod's NCCL ring-allreduce average
+gradients outside the TF graph.
+
+TPU-native inversion: JAX is a *single-controller SPMD* system — one Python
+process drives all local chips, and multi-host pods run the **same** program
+per host with ``jax.distributed`` providing rendezvous. So ``run`` does not
+fork N workers; it builds an N-device ``jax.sharding.Mesh``, hands ``main_fn``
+a :class:`RunnerContext`, and the "allreduce" happens *inside* the compiled
+train step as an XLA collective riding ICI (see ``train_state.py``). The
+``np=N`` API shape is preserved for migration; ``np=-1`` means all devices.
+
+Multi-host: pass ``coordinator="host:port", num_processes=H, process_id=i``
+(or set the standard TPU pod env) and each host calls ``run`` with the same
+program — ``jax.distributed.initialize`` does the rendezvous that mpirun did,
+DCN carries the cross-host legs of the collectives, ICI the intra-slice legs.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core import runtime
+from . import metrics as metrics_lib
+from .checkpoint import CheckpointManager
+from .train_state import (TrainState, make_eval_step, make_shard_map_step,
+                          make_train_step)
+
+log = logging.getLogger("sparkdl_tpu.runner")
+
+_CURRENT_CONTEXT: list["RunnerContext"] = []
+
+
+@dataclass
+class RunnerContext:
+    """What ``main_fn`` receives — the hvd.{rank,size,...} surface plus the
+    mesh-first primitives the TPU design is actually built on."""
+    mesh: Mesh
+    data_axis: str = "data"
+    checkpoint_dir: str | None = None
+    log_dir: str | None = None
+    _ckpt: CheckpointManager | None = field(default=None, repr=False)
+
+    # -- hvd-compat identity --------------------------------------------
+    @property
+    def size(self) -> int:  # total chips (hvd.size ≈ world size)
+        return self.mesh.devices.size
+
+    @property
+    def rank(self) -> int:  # process index (hvd.rank for the controller)
+        return jax.process_index()
+
+    @property
+    def num_processes(self) -> int:
+        return jax.process_count()
+
+    @property
+    def local_device_count(self) -> int:
+        return jax.local_device_count()
+
+    # -- shardings -------------------------------------------------------
+    def data_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P(self.data_axis))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def shard_batch(self, batch):
+        """Host numpy pytree → global array sharded over the data axis."""
+        sh = self.data_sharding()
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, sh), batch)
+
+    # -- compiled steps ---------------------------------------------------
+    def make_train_step(self, loss_fn, explicit_collectives: bool = False,
+                        **kw):
+        maker = make_shard_map_step if explicit_collectives else make_train_step
+        return maker(loss_fn, self.mesh, data_axis=self.data_axis, **kw)
+
+    def make_eval_step(self, eval_fn):
+        return make_eval_step(eval_fn, self.mesh, data_axis=self.data_axis)
+
+    # -- aux subsystems ----------------------------------------------------
+    @property
+    def checkpoints(self) -> CheckpointManager | None:
+        if self._ckpt is None and self.checkpoint_dir:
+            self._ckpt = CheckpointManager(self.checkpoint_dir)
+        return self._ckpt
+
+    def trace(self, log_dir: str | None = None):
+        return metrics_lib.trace(log_dir or (self.log_dir or "/tmp/sparkdl_tb"))
+
+    def meter(self, warmup_steps: int = 1) -> metrics_lib.ThroughputMeter:
+        return metrics_lib.ThroughputMeter(n_chips=self.size,
+                                           warmup_steps=warmup_steps)
+
+    # -- batteries-included training loop ---------------------------------
+    def fit(self, *, loss_fn: Callable, params: Any, tx,
+            data: Iterable, num_steps: int,
+            apply_fn: Callable | None = None,
+            eval_fn: Callable | None = None, eval_data: Iterable | None = None,
+            eval_every: int = 0, checkpoint_every: int = 0,
+            log_every: int = 10, explicit_collectives: bool = False,
+            resume: bool = True, profile_dir: str | None = None) -> dict:
+        """Run a full training loop; returns {state, meter, history}.
+
+        Streams ``data`` (iterator of host-numpy batch dicts), shards each
+        batch over the data axis, runs the compiled step, meters
+        examples/s/chip, checkpoints every ``checkpoint_every`` steps, and
+        resumes from the latest checkpoint when ``resume`` and one exists —
+        the checkpoint-and-restart failure-recovery story (SURVEY.md §5.3).
+        """
+        state = TrainState.create(apply_fn or (lambda p, x: p), params, tx)
+        start_step = 0
+        if resume and self.checkpoints and \
+                self.checkpoints.latest_step() is not None:
+            state = self.checkpoints.restore(state)
+            start_step = int(state.step)
+            log.info("resumed from checkpoint at step %d", start_step)
+        # Replicate state over the mesh: fresh params arrive on one device
+        # (and orbax restores there too); the sharded batch needs the state
+        # addressable on every mesh device.
+        rep = self.replicated()
+        state = jax.tree_util.tree_map(
+            lambda x: jax.device_put(np.asarray(x), rep), state)
+
+        step_fn = self.make_train_step(
+            loss_fn, explicit_collectives=explicit_collectives)
+        meter = self.meter()
+        logger = metrics_lib.MetricsLogger(self.log_dir, every=log_every)
+        eval_step = self.make_eval_step(eval_fn) if eval_fn else None
+        history: list[dict] = []
+
+        data_it = iter(data)
+        if profile_dir:
+            jax.profiler.start_trace(profile_dir)
+        try:
+            for i in range(start_step, num_steps):
+                try:
+                    batch = next(data_it)
+                except StopIteration:
+                    break
+                n = len(jax.tree_util.tree_leaves(batch)[0])
+                with metrics_lib.step_annotation(i):
+                    state, m = step_fn(state, self.shard_batch(batch))
+                # Host sync only at metering/logging boundaries; otherwise
+                # steps stay enqueued and transfers overlap compute.
+                if (i + 1) % log_every == 0 or i + 1 == num_steps:
+                    m = {k: float(v) for k, v in m.items()}
+                    meter.update(n)
+                    m["examples_per_sec_per_chip"] = \
+                        meter.recent_examples_per_sec() / max(self.size, 1)
+                    logger.log(i + 1, m)
+                    history.append({"step": i + 1, **m})
+                else:
+                    meter.update(n)
+                if checkpoint_every and self.checkpoints and \
+                        (i + 1) % checkpoint_every == 0:
+                    self.checkpoints.save(i + 1, state)
+                if eval_step and eval_every and (i + 1) % eval_every == 0 \
+                        and eval_data is not None:
+                    evm = _run_eval(eval_step, state, eval_data,
+                                    self.shard_batch)
+                    logger.log(i + 1, {f"eval_{k}": v for k, v in evm.items()})
+        finally:
+            if profile_dir:
+                jax.profiler.stop_trace()
+        jax.block_until_ready(state.params)
+        if self.checkpoints:
+            self.checkpoints.save(num_steps, state, wait=True)
+        logger.close()
+        return {"state": state, "meter": meter, "history": history}
+
+
+def _run_eval(eval_step, state, eval_data, shard):
+    totals: dict[str, float] = {}
+    n = 0
+    for batch in eval_data:
+        m = eval_step(state, shard(batch))
+        bs = len(jax.tree_util.tree_leaves(batch)[0])
+        for k, v in m.items():
+            totals[k] = totals.get(k, 0.0) + float(v) * bs
+        n += bs
+    return {k: v / max(n, 1) for k, v in totals.items()}
+
+
+def current_context() -> RunnerContext | None:
+    return _CURRENT_CONTEXT[-1] if _CURRENT_CONTEXT else None
+
+
+class XlaRunner:
+    """``XlaRunner(np=N).run(main_fn, **kwargs)`` — HorovodRunner, TPU-style.
+
+    ``np``: number of chips to span (-1 = all visible). ``axes``: optional
+    mesh axes dict (e.g. ``{"data": 4, "model": 2}``) for beyond-DP layouts;
+    default is one ``data`` axis — the reference's only strategy.
+    """
+
+    def __init__(self, np: int = -1, axes: dict[str, int] | None = None,
+                 checkpoint_dir: str | None = None,
+                 log_dir: str | None = None,
+                 coordinator: str | None = None,
+                 num_processes: int | None = None,
+                 process_id: int | None = None):
+        if coordinator is not None:
+            # Multi-host rendezvous — the mpirun/barrier-mode replacement.
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=num_processes, process_id=process_id)
+        devs = jax.devices()
+        n = len(devs) if np in (-1, None) else int(np)
+        if n > len(devs):
+            raise ValueError(
+                f"np={n} exceeds visible devices ({len(devs)}). Multi-host "
+                "scaling uses coordinator/num_processes, not np inflation.")
+        self.devices = devs[:n]
+        self.axes = axes or {"data": n}
+        self.checkpoint_dir = checkpoint_dir
+        self.log_dir = log_dir
+
+    def make_context(self) -> RunnerContext:
+        mesh = runtime.make_mesh(self.axes, self.devices)
+        data_axis = next(iter(self.axes))
+        return RunnerContext(mesh=mesh, data_axis=data_axis,
+                             checkpoint_dir=self.checkpoint_dir,
+                             log_dir=self.log_dir)
+
+    def run(self, main_fn: Callable, **kwargs) -> Any:
+        """Invoke ``main_fn(ctx, **kwargs)`` under an active mesh.
+
+        Unlike HorovodRunner there is no pickling/forking: SPMD means one
+        program, and that program is already here.
+        """
+        ctx = self.make_context()
+        _CURRENT_CONTEXT.append(ctx)
+        try:
+            with ctx.mesh:
+                return main_fn(ctx, **kwargs)
+        finally:
+            _CURRENT_CONTEXT.pop()
+
+    def run_with_restarts(self, main_fn: Callable, max_restarts: int = 2,
+                          backoff_s: float = 1.0, **kwargs) -> Any:
+        """Checkpoint-and-restart supervision (SURVEY.md §5.3): re-invoke
+        ``main_fn`` on failure; with a checkpoint_dir set, ``ctx.fit`` resumes
+        from the last saved step, so a restart loses at most
+        ``checkpoint_every`` steps — the reference's whole-job-retry story,
+        minus losing the whole job."""
+        attempt = 0
+        while True:
+            try:
+                return self.run(main_fn, **kwargs)
+            except Exception:
+                attempt += 1
+                if attempt > max_restarts:
+                    raise
+                log.exception("run failed; restart %d/%d", attempt,
+                              max_restarts)
+                time.sleep(backoff_s * attempt)

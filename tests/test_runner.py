@@ -1,0 +1,226 @@
+"""XlaRunner tests on the virtual 8-device CPU mesh.
+
+Strategy mirrors the reference's (SURVEY.md §4): a local-mode engine exercises
+the full distributed machinery in-process, and correctness is equivalence —
+the sharded SPMD step must match a single-device numpy/jax reference step
+bit-for-bit (same inputs, same update math).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from sparkdl_tpu.runner import (CheckpointManager, TrainState, ThroughputMeter,
+                                XlaRunner, make_shard_map_step,
+                                make_train_step, softmax_cross_entropy_loss)
+from sparkdl_tpu.runner import api as hvd
+from sparkdl_tpu.core import runtime
+
+
+def _linear_apply(params, x):
+    return x @ params["w"] + params["b"]
+
+
+def _make_problem(seed=0, dim=4, classes=3):
+    rng = np.random.RandomState(seed)
+    # Host numpy (not jnp): donated train steps delete their input device
+    # buffers, so each TrainState gets its own device copy of these.
+    params = {"w": rng.randn(dim, classes).astype(np.float32),
+              "b": np.zeros((classes,), np.float32)}
+    x = rng.randn(16, dim).astype(np.float32)
+    y = rng.randint(0, classes, size=(16,))
+    return params, {"image": x, "label": y}
+
+
+def _reference_step(params, batch, lr=0.1):
+    """Plain single-device step for equivalence checking."""
+    def loss(p):
+        logits = _linear_apply(p, jnp.asarray(batch["image"]))
+        onehot = jax.nn.one_hot(batch["label"], logits.shape[-1])
+        return optax.softmax_cross_entropy(logits, onehot).mean()
+
+    grads = jax.grad(loss)(params)
+    return jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return XlaRunner(np=8)
+
+
+class TestTrainStep:
+    @pytest.mark.parametrize("explicit", [False, True])
+    def test_matches_single_device_reference(self, runner, explicit):
+        """The SPMD step (implicit XLA collective or explicit shard_map
+        pmean) must equal the plain single-device SGD step."""
+        ctx = runner.make_context()
+        params, batch = _make_problem()
+        loss_fn = softmax_cross_entropy_loss()
+        state = TrainState.create(_linear_apply, params,
+                                  optax.sgd(0.1))
+        step = ctx.make_train_step(loss_fn, explicit_collectives=explicit)
+        with ctx.mesh:
+            new_state, metrics = step(state, ctx.shard_batch(batch))
+        expected = _reference_step(params, batch)
+        for k in ("w", "b"):
+            np.testing.assert_allclose(np.asarray(new_state.params[k]),
+                                       np.asarray(expected[k]),
+                                       rtol=2e-5, atol=2e-6)
+        assert float(metrics["loss"]) > 0
+        assert int(new_state.step) == 1
+
+    def test_explicit_and_implicit_agree(self, runner):
+        ctx = runner.make_context()
+        params, batch = _make_problem(seed=1)
+        loss_fn = softmax_cross_entropy_loss()
+        tx = optax.adam(1e-2)
+        with ctx.mesh:
+            s1, _ = make_train_step(loss_fn, ctx.mesh)(
+                TrainState.create(_linear_apply, params, tx),
+                ctx.shard_batch(batch))
+            s2, _ = make_shard_map_step(loss_fn, ctx.mesh)(
+                TrainState.create(_linear_apply, params, tx),
+                ctx.shard_batch(batch))
+        for k in ("w", "b"):
+            np.testing.assert_allclose(np.asarray(s1.params[k]),
+                                       np.asarray(s2.params[k]),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_batch_actually_sharded(self, runner):
+        """The input batch must land split over the data axis — 8 shards."""
+        ctx = runner.make_context()
+        _, batch = _make_problem()
+        sharded = ctx.shard_batch(batch)
+        assert len(sharded["image"].sharding.device_set) == 8
+        shard_shapes = {s.data.shape for s in sharded["image"].addressable_shards}
+        assert shard_shapes == {(2, 4)}  # 16 rows / 8 devices
+
+
+class TestRunnerApi:
+    def test_run_passes_context(self):
+        out = XlaRunner(np=8).run(lambda ctx, k: (ctx.size, k), k=42)
+        assert out == (8, 42)
+
+    def test_np_subset(self):
+        assert XlaRunner(np=4).run(lambda ctx: ctx.mesh.devices.size) == 4
+
+    def test_np_too_large(self):
+        with pytest.raises(ValueError):
+            XlaRunner(np=99)
+
+    def test_hvd_compat_shim(self):
+        def main(ctx):
+            assert hvd.size() == 8
+            assert hvd.rank() == 0
+            s = hvd.allreduce(jnp.ones((3,)), average=False)
+            np.testing.assert_allclose(np.asarray(s), 8 * np.ones(3))
+            m = hvd.allreduce(jnp.full((3,), 2.0), average=True)
+            np.testing.assert_allclose(np.asarray(m), 2 * np.ones(3))
+            return True
+
+        assert XlaRunner(np=8).run(lambda ctx: main(ctx))
+
+
+class TestFitLoop:
+    def _data(self, n_batches=12, bs=16, seed=0):
+        rng = np.random.RandomState(seed)
+        w_true = rng.randn(4, 3).astype(np.float32)
+        for _ in range(n_batches):
+            x = rng.randn(bs, 4).astype(np.float32)
+            y = (x @ w_true).argmax(-1)
+            yield {"image": x, "label": y}
+
+    def test_fit_learns_and_meters(self, tmp_path):
+        runner = XlaRunner(np=8, checkpoint_dir=str(tmp_path / "ckpt"))
+        params, _ = _make_problem(seed=3)
+
+        def main(ctx):
+            return ctx.fit(loss_fn=softmax_cross_entropy_loss(),
+                           params=params, tx=optax.adam(5e-2),
+                           apply_fn=_linear_apply,
+                           data=self._data(), num_steps=12,
+                           checkpoint_every=5, log_every=4)
+
+        res = runner.run(main)
+        assert int(res["state"].step) == 12
+        losses = [h["loss"] for h in res["history"]]
+        assert losses[-1] < losses[0]
+        assert res["meter"].steps == 12
+
+    def test_checkpoint_resume(self, tmp_path):
+        """Kill-and-restart: a second fit with the same checkpoint_dir must
+        resume from the saved step, not from scratch (SURVEY.md §5.3)."""
+        ckpt = str(tmp_path / "ckpt")
+        params, _ = _make_problem(seed=4)
+        kw = dict(loss_fn=softmax_cross_entropy_loss(), params=params,
+                  tx=optax.sgd(0.1), apply_fn=_linear_apply,
+                  checkpoint_every=3, log_every=100)
+
+        r1 = XlaRunner(np=8, checkpoint_dir=ckpt).run(
+            lambda ctx: ctx.fit(data=self._data(), num_steps=6, **kw))
+        assert int(r1["state"].step) == 6
+
+        seen = []
+
+        def main2(ctx):
+            res = ctx.fit(data=self._data(), num_steps=9, **kw)
+            seen.append(res)
+            return res
+
+        r2 = XlaRunner(np=8, checkpoint_dir=ckpt).run(main2)
+        # resumed at 6 → only 3 more steps ran
+        assert int(r2["state"].step) == 9
+        assert r2["meter"].steps == 3
+
+    def test_run_with_restarts_fault_injection(self, tmp_path):
+        """Fault injection (SURVEY.md §5.3): main_fn dies mid-training once;
+        supervision restarts it and it resumes from the checkpoint."""
+        ckpt = str(tmp_path / "ckpt")
+        params, _ = _make_problem(seed=5)
+        attempts = []
+
+        def main(ctx):
+            attempts.append(1)
+            res = ctx.fit(loss_fn=softmax_cross_entropy_loss(), params=params,
+                          tx=optax.sgd(0.1), apply_fn=_linear_apply,
+                          data=self._data(), num_steps=4,
+                          checkpoint_every=2, log_every=100)
+            if len(attempts) == 1:
+                raise RuntimeError("injected chip failure")
+            return res
+
+        res = XlaRunner(np=8, checkpoint_dir=ckpt).run_with_restarts(
+            main, max_restarts=2, backoff_s=0.0)
+        assert len(attempts) == 2
+        assert int(res["state"].step) == 4
+
+
+class TestCheckpointManager:
+    def test_roundtrip(self, tmp_path):
+        params, _ = _make_problem()
+        state = TrainState.create(_linear_apply, params, optax.adam(1e-3))
+        mngr = CheckpointManager(str(tmp_path), async_save=False)
+        mngr.save(7, state, wait=True)
+        assert mngr.latest_step() == 7
+
+        fresh = TrainState.create(_linear_apply,
+                                  jax.tree_util.tree_map(jnp.zeros_like,
+                                                         params),
+                                  optax.adam(1e-3))
+        restored = mngr.restore(fresh)
+        np.testing.assert_allclose(np.asarray(restored.params["w"]),
+                                   np.asarray(params["w"]))
+        mngr.close()
+
+
+def test_throughput_meter_warmup():
+    m = ThroughputMeter(n_chips=8, warmup_steps=1)
+    m.update(64)  # warmup (compile) step — excluded
+    for _ in range(5):
+        m.update(64)
+    s = m.summary()
+    assert s["examples"] == 5 * 64
+    assert s["n_chips"] == 8
+    assert s["examples_per_sec"] > 0
