@@ -1,0 +1,106 @@
+"""Benchmark: ResNet-50 data-parallel training throughput (img/s/chip).
+
+The BASELINE.json headline metric ("HorovodRunner ResNet-50 img/s/chip") —
+here trained through XlaRunner's compiled SPMD step on whatever chips are
+visible (one real v5e chip under axon; the driver records the result).
+
+Prints exactly ONE JSON line:
+    {"metric": ..., "value": N, "unit": "img/s/chip", "vs_baseline": N}
+
+The reference published no numbers (SURVEY.md §6; BASELINE.json
+`"published": {}`), so ``vs_baseline`` compares against a locally recorded
+prior run (``BENCH_BASELINE.json``) when present, else 1.0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def bench_resnet50_train(batch_per_chip: int = 64, steps: int = 20,
+                         warmup: int = 3) -> float:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from sparkdl_tpu.models.registry import get_model
+    from sparkdl_tpu.runner import TrainState, XlaRunner, bn_classifier_loss
+
+    runner = XlaRunner(np=-1)
+
+    def main(ctx):
+        spec = get_model("ResNet50")
+        # bf16 activations/params on the MXU; the loss reduction upcasts to
+        # f32 inside the step (train_state.py).
+        model = spec.build(dtype=jnp.bfloat16)
+
+        @jax.jit
+        def init(key):
+            return model.init(key, jnp.zeros((1, 224, 224, 3)), train=False)
+
+        variables = jax.tree_util.tree_map(
+            np.asarray, init(jax.random.PRNGKey(0)))
+        batch_stats = {"batch_stats": variables["batch_stats"]}
+
+        state = TrainState.create(
+            None, variables["params"], optax.sgd(1e-3, momentum=0.9),
+            model_state=batch_stats)
+        state = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, ctx.replicated()), state)
+
+        n = batch_per_chip * ctx.size
+        rng = np.random.RandomState(0)
+        batch = {
+            "image": rng.randint(0, 256, size=(n, 224, 224, 3))
+                       .astype(np.float32),
+            "label": rng.randint(0, 1000, size=(n,)),
+        }
+        step = ctx.make_train_step(
+            bn_classifier_loss(model, spec.preprocess), mutable=True)
+        sharded = ctx.shard_batch(batch)
+
+        for _ in range(warmup):  # includes XLA compile
+            state, m = step(state, sharded)
+        jax.block_until_ready(state.params)
+
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = step(state, sharded)
+        jax.block_until_ready(state.params)
+        dt = time.perf_counter() - t0
+        assert np.isfinite(float(m["loss"])), "training diverged"
+        return (steps * n) / dt / ctx.size
+
+    return runner.run(main)
+
+
+def main():
+    batch = int(os.environ.get("BENCH_BATCH_PER_CHIP", "64"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    value = bench_resnet50_train(batch_per_chip=batch, steps=steps)
+
+    vs = 1.0
+    base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_BASELINE.json")
+    if os.path.exists(base_path):
+        try:
+            base = json.load(open(base_path)).get("value")
+            if base:
+                vs = value / float(base)
+        except (ValueError, OSError):
+            pass
+
+    print(json.dumps({
+        "metric": "resnet50_dp_train_throughput",
+        "value": round(value, 2),
+        "unit": "img/s/chip",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,3 +1,7 @@
+from .bert import (BertConfig, BertEncoder, BertForSequenceClassification,
+                   bert_finetune_loss, glue_loss_fn)
+from .llama import (LlamaConfig, LlamaModel, causal_lm_loss_fn, lora_mask,
+                    lora_optimizer)
 from .registry import (SUPPORTED_MODELS, NamedImageModel, decodePredictions,
                        get_model, load_safetensors, load_weights,
                        preprocess_caffe, preprocess_tf, preprocess_torch,
@@ -7,4 +11,8 @@ __all__ = [
     "SUPPORTED_MODELS", "NamedImageModel", "get_model", "decodePredictions",
     "preprocess_tf", "preprocess_caffe", "preprocess_torch",
     "save_weights", "load_weights", "load_safetensors", "save_safetensors",
+    "BertConfig", "BertEncoder", "BertForSequenceClassification",
+    "glue_loss_fn", "bert_finetune_loss",
+    "LlamaConfig", "LlamaModel", "causal_lm_loss_fn", "lora_mask",
+    "lora_optimizer",
 ]

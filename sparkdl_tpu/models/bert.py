@@ -1,0 +1,188 @@
+"""BERT-base encoder (flax) — the XlaRunner GLUE fine-tune family.
+
+The reference predates BERT entirely; this family exists for BASELINE
+config 4 ("XlaRunner: BERT-base fine-tune on GLUE with Spark DataFrame
+reader"). TPU-first choices:
+
+- static [B, S] shapes, attention mask as an additive bias (no dynamic
+  slicing) so XLA compiles one program per sequence length;
+- module names (``query``/``key``/``value``/``attention_output``/
+  ``intermediate``/``output_dense``/``word_embeddings``) line up with
+  ``parallel.transformer_tp_rules`` so the same checkpoint runs replicated
+  (DP) or tensor-parallel without renaming;
+- dtype-parameterized (bfloat16 compute on the MXU, f32 layernorm/softmax).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dropout_rate: float = 0.1
+
+    @classmethod
+    def base(cls) -> "BertConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls) -> "BertConfig":
+        """For tests/dryruns: 2 layers, 128-wide."""
+        return cls(vocab_size=1000, hidden_size=128, num_layers=2,
+                   num_heads=4, intermediate_size=256,
+                   max_position_embeddings=128)
+
+
+class BertSelfAttention(nn.Module):
+    cfg: BertConfig
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, bias, deterministic: bool):
+        c, d = self.cfg, self.dtype
+        head_dim = c.hidden_size // c.num_heads
+        dense = lambda name: nn.Dense(c.hidden_size, dtype=d, name=name)
+        # [B, S, H*D] → [B, H, S, D]
+        split = lambda t: t.reshape(t.shape[0], t.shape[1], c.num_heads,
+                                    head_dim).transpose(0, 2, 1, 3)
+        q = split(dense("query")(x))
+        k = split(dense("key")(x))
+        v = split(dense("value")(x))
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(head_dim)
+        s = s.astype(jnp.float32) + bias  # mask as additive bias, f32 softmax
+        p = jax.nn.softmax(s, axis=-1).astype(d)
+        p = nn.Dropout(c.dropout_rate)(p, deterministic=deterministic)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+        o = o.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[1],
+                                            c.hidden_size)
+        return nn.Dense(c.hidden_size, dtype=d, name="attention_output")(o)
+
+
+class BertLayer(nn.Module):
+    cfg: BertConfig
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, bias, deterministic: bool):
+        c, d = self.cfg, self.dtype
+        a = BertSelfAttention(c, d, name="attention")(x, bias, deterministic)
+        a = nn.Dropout(c.dropout_rate)(a, deterministic=deterministic)
+        x = nn.LayerNorm(epsilon=c.layer_norm_eps, dtype=jnp.float32,
+                         name="attention_norm")(x + a)
+        h = nn.Dense(c.intermediate_size, dtype=d, name="intermediate")(x)
+        h = nn.gelu(h, approximate=False)
+        h = nn.Dense(c.hidden_size, dtype=d, name="output_dense")(h)
+        h = nn.Dropout(c.dropout_rate)(h, deterministic=deterministic)
+        return nn.LayerNorm(epsilon=c.layer_norm_eps, dtype=jnp.float32,
+                            name="output_norm")(x + h)
+
+
+class BertEncoder(nn.Module):
+    """Token ids (+mask, +segments) → (sequence_output, pooled_output)."""
+    cfg: BertConfig
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic: bool = True):
+        c, d = self.cfg, self.dtype
+        B, S = input_ids.shape
+        if attention_mask is None:
+            attention_mask = jnp.ones((B, S), jnp.int32)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros((B, S), jnp.int32)
+
+        emb = nn.Embed(c.vocab_size, c.hidden_size, dtype=d,
+                       name="word_embeddings")(input_ids)
+        pos = nn.Embed(c.max_position_embeddings, c.hidden_size, dtype=d,
+                       name="position_embeddings")(jnp.arange(S)[None, :])
+        seg = nn.Embed(c.type_vocab_size, c.hidden_size, dtype=d,
+                       name="token_type_embeddings")(token_type_ids)
+        x = nn.LayerNorm(epsilon=c.layer_norm_eps, dtype=jnp.float32,
+                         name="embeddings_norm")(emb + pos + seg)
+        x = nn.Dropout(c.dropout_rate)(x, deterministic=deterministic)
+        x = x.astype(d)
+
+        # [B, S] mask → additive bias [B, 1, 1, S]
+        bias = (1.0 - attention_mask[:, None, None, :].astype(jnp.float32)) \
+            * -1e30
+        for i in range(c.num_layers):
+            x = BertLayer(c, d, name=f"layer_{i}")(x, bias, deterministic)
+
+        pooled = nn.tanh(nn.Dense(c.hidden_size, dtype=d,
+                                  name="pooler")(x[:, 0]))
+        return x, pooled
+
+
+class BertForSequenceClassification(nn.Module):
+    """The GLUE head: encoder + dropout + linear over pooled [CLS]."""
+    cfg: BertConfig
+    num_classes: int = 2
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic: bool = True):
+        _, pooled = BertEncoder(self.cfg, self.dtype, name="bert")(
+            input_ids, attention_mask, token_type_ids, deterministic)
+        pooled = nn.Dropout(self.cfg.dropout_rate)(
+            pooled, deterministic=deterministic)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="classifier")(pooled)
+
+
+def glue_loss_fn():
+    """loss_fn for RunnerContext.fit: batch = {input_ids, attention_mask,
+    token_type_ids?, label}. ``apply_fn(params, batch)`` runs deterministic
+    (no dropout); for dropout-regularized fine-tuning use
+    ``bert_finetune_loss`` with ``with_rng=True`` steps."""
+    import optax
+
+    def loss_fn(params, apply_fn, batch, rng=None):
+        if rng is None:
+            logits = apply_fn(params, batch)
+        else:
+            logits = apply_fn(params, batch, rng)
+        logits = logits.astype(jnp.float32)
+        onehot = jax.nn.one_hot(batch["label"], logits.shape[-1])
+        loss = optax.softmax_cross_entropy(logits, onehot).mean()
+        acc = (logits.argmax(-1) == batch["label"]).mean()
+        return loss, {"accuracy": acc.astype(jnp.float32)}
+
+    return loss_fn
+
+
+def bert_finetune_loss(model: BertForSequenceClassification):
+    """Dropout-active GLUE fine-tune loss: pair with a ``with_rng=True``
+    train step (RunnerContext.fit(with_rng=True)) so each step gets fresh
+    dropout noise; falls back to deterministic when no rng is plumbed."""
+    import optax
+
+    def loss_fn(params, apply_fn, batch, rng=None):
+        det = rng is None
+        logits = model.apply(
+            params, batch["input_ids"], batch.get("attention_mask"),
+            batch.get("token_type_ids"), deterministic=det,
+            rngs=None if det else {"dropout": rng})
+        logits = logits.astype(jnp.float32)
+        onehot = jax.nn.one_hot(batch["label"], logits.shape[-1])
+        loss = optax.softmax_cross_entropy(logits, onehot).mean()
+        acc = (logits.argmax(-1) == batch["label"]).mean()
+        return loss, {"accuracy": acc.astype(jnp.float32)}
+
+    return loss_fn

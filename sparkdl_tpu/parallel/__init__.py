@@ -1,0 +1,13 @@
+"""Parallelism toolkit: sharding rules (DP/TP/LoRA) and sequence parallelism
+(ring attention, Ulysses). See sharding.py and ring_attention.py."""
+
+from .ring_attention import (dense_attention, ring_attention,
+                             ulysses_attention)
+from .sharding import (describe, lora_rules, make_rules, shard_params,
+                       sharding_pytree, transformer_tp_rules)
+
+__all__ = [
+    "make_rules", "shard_params", "sharding_pytree", "describe",
+    "transformer_tp_rules", "lora_rules",
+    "ring_attention", "ulysses_attention", "dense_attention",
+]

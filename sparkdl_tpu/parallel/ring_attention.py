@@ -1,0 +1,132 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+Entirely absent from the 2017-era reference (SURVEY.md §2.4, §5.7) — this is
+the framework's long-context story, designed TPU-first:
+
+- **Ring attention** (``ring_attention``): sequence sharded over a mesh axis;
+  KV blocks rotate around the ICI ring via ``jax.lax.ppermute`` inside a
+  ``shard_map``-ed ``lax.fori_loop``, with flash-style streaming-softmax
+  accumulation so each hop's compute overlaps the neighbor transfer and no
+  chip ever materializes the full [S, S] score matrix. Memory per chip is
+  O(S/n · S/n) scores + O(S/n) KV — sequence length scales linearly with
+  ring size.
+- **Ulysses** (``ulysses_attention``): the all-to-all alternative — swap the
+  sequence sharding for a head sharding (`all_to_all` over ICI), run dense
+  local attention on full sequences for the local head subset, swap back.
+  Cheaper at moderate S (two all-to-alls vs n ppermute hops) but caps the
+  parallelism degree at num_heads.
+
+Both are jit-compatible, causal-mask aware via global position arithmetic,
+and verified equivalent to single-device dense attention in
+tests/test_parallel.py.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30  # large-but-finite: -inf breaks the streaming-softmax max
+
+
+def dense_attention(q, k, v, causal: bool = False):
+    """Reference single-device attention. [B, H, S, D] layout."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        S = q.shape[2]
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+def _ring_shard(q, k, v, *, axis_name: str, causal: bool):
+    """Per-shard body: q/k/v are local blocks [B, H, T, D]; T = S/ring."""
+    ring = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    B, H, T, D = q.shape
+    scale = 1.0 / math.sqrt(D)
+    qf = q.astype(jnp.float32)
+
+    perm = [(j, (j + 1) % ring) for j in range(ring)]
+    q_pos = my_idx * T + jnp.arange(T)  # global query positions
+
+    def hop(i, carry):
+        o, m, l, kv = carry
+        kb, vb = kv
+        # After i forward rotations, the block we hold originated on ring
+        # neighbor (my_idx - i) mod ring — that index gives global key
+        # positions for causal masking.
+        src = (my_idx - i) % ring
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kb.astype(jnp.float32)) * scale
+        if causal:
+            k_pos = src * T + jnp.arange(T)
+            s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1)
+        o_new = o * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vb.astype(jnp.float32))
+        # Rotate KV to the next ring neighbor; XLA overlaps this ppermute
+        # with the next hop's einsums (the ring-attention overlap trick).
+        kv_next = jax.lax.ppermute((kb, vb), axis_name, perm)
+        return o_new, m_new, l_new, kv_next
+
+    o0 = jnp.zeros((B, H, T, D), jnp.float32)
+    m0 = jnp.full((B, H, T), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, T), jnp.float32)
+    o, m, l, _ = jax.lax.fori_loop(0, ring, hop, (o0, m0, l0, (k, v)))
+    return (o / l[..., None]).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
+                   causal: bool = False):
+    """Sequence-parallel attention over mesh axis ``axis``.
+
+    Inputs [B, H, S, D] sharded (or shardable) on S over ``axis``; output has
+    the same layout. Jit-safe; compose inside larger jitted programs.
+    """
+    body = functools.partial(_ring_shard, axis_name=axis, causal=causal)
+    spec = P(None, None, axis, None)
+    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+
+
+def _ulysses_shard(q, k, v, *, axis_name: str, causal: bool):
+    """Per-shard body: [B, H, T, D] seq-sharded in → seq-sharded out."""
+    n = jax.lax.axis_size(axis_name)
+
+    def seq_to_heads(x):
+        # [B, H, S/n, D] → all_to_all: scatter heads, gather sequence →
+        # [B, H/n, S, D]. split_axis=1 (heads), concat_axis=2 (sequence).
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    o = dense_attention(qh, kh, vh, causal=causal)
+    return heads_to_seq(o)
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp",
+                      causal: bool = False):
+    """Ulysses-style sequence parallelism: all_to_all head-scatter /
+    seq-gather, dense attention on local heads, inverse all_to_all.
+    Requires num_heads % axis_size == 0."""
+    n = mesh.shape[axis]
+    if q.shape[1] % n:
+        raise ValueError(
+            f"num_heads={q.shape[1]} not divisible by {axis}={n}")
+    body = functools.partial(_ulysses_shard, axis_name=axis, causal=causal)
+    spec = P(None, None, axis, None)
+    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)

@@ -1,0 +1,139 @@
+"""Sharding-rule helpers: pattern-matched PartitionSpecs over param pytrees.
+
+The reference had exactly one parallelism layout (replicated params, Horovod
+DP — SURVEY.md §2.4); everything beyond it is TPU-native design space. This
+module is the one place layouts are expressed: a rule list maps param-path
+patterns to ``PartitionSpec``s, and everything downstream (train steps,
+checkpointing, the dryrun) consumes the resulting sharding pytree. XLA turns
+the specs into ICI collectives; no manual comms anywhere.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def path_str(path) -> str:
+    """jax key-path → '/'-joined string (e.g. 'params/dense/kernel')."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def make_rules(patterns: Sequence[tuple[str, P]],
+               default: P = P()) -> Callable[[tuple, Any], P]:
+    """Build a ``rules(path, leaf) -> PartitionSpec`` fn from
+    (regex, spec) pairs, first match wins. Regexes are ``re.search`` over the
+    '/'-joined param path."""
+    compiled = [(re.compile(pat), spec) for pat, spec in patterns]
+
+    def match_str(s: str, leaf) -> P:
+        for rx, spec in compiled:
+            if rx.search(s):
+                # Drop trailing axes the leaf doesn't have (a bias matching a
+                # kernel rule).
+                nd = getattr(leaf, "ndim", None)
+                if nd is not None and len(spec) > nd:
+                    spec = P(*spec[:nd])
+                return spec
+        return default
+
+    def rules(path, leaf) -> P:
+        return match_str(path_str(path), leaf)
+
+    rules.match_str = match_str
+    return rules
+
+
+def shard_params(params: Any, mesh: Mesh, rules: Callable) -> Any:
+    """Place a param pytree according to the rules (host → sharded HBM)."""
+    def put(path, leaf):
+        return jax.device_put(leaf, NamedSharding(mesh, rules(path, leaf)))
+
+    return jax.tree_util.tree_map_with_path(put, params)
+
+
+def sharding_pytree(params: Any, mesh: Mesh, rules: Callable) -> Any:
+    """NamedSharding pytree (for jit in_shardings / orbax restore args)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(mesh, rules(path, leaf)), params)
+
+
+def describe(params: Any, rules: Callable) -> dict[str, str]:
+    """path → spec string, for debugging/sharding audits."""
+    out = {}
+
+    def visit(path, leaf):
+        out[path_str(path)] = str(rules(path, leaf))
+        return leaf
+
+    jax.tree_util.tree_map_with_path(visit, params)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Canonical transformer TP layouts (Megatron-style, mesh axis 'model')
+# ---------------------------------------------------------------------------
+
+def transformer_tp_rules(model_axis: str = "model",
+                         data_axis: str | None = None) -> Callable:
+    """Tensor-parallel rules for the transformer families in ``models/``:
+
+    - attention q/k/v projections: shard the head (output) dim → each chip
+      computes a head subset; the out-projection shards its *input* dim so
+      the follow-up matmul contracts locally and one psum restores the sum.
+    - MLP: up-projection output-sharded, down-projection input-sharded —
+      the classic pair that needs exactly one allreduce per block.
+    - embeddings/lm_head: vocab-sharded.
+    - everything else (norms, biases): replicated.
+
+    With ``data_axis`` set, 2-D FSDP-style layouts can extend these rules;
+    the baseline configs need only 1-D TP + DP batch sharding.
+    """
+    m = model_axis
+    # (/base)? skips the LoRADense wrapper segment (models/llama.py): the
+    # frozen kernel lives at e.g. 'q_proj/base/kernel'.
+    return make_rules([
+        (r"(q_proj|k_proj|v_proj|query|key|value)(/base)?/kernel",
+         P(None, m)),
+        (r"(o_proj|out_proj|attention_output)(/base)?/kernel", P(m, None)),
+        (r"(up_proj|gate_proj|intermediate|fc1|mlp_in)(/base)?/kernel",
+         P(None, m)),
+        (r"(down_proj|output_dense|fc2|mlp_out)(/base)?/kernel", P(m, None)),
+        (r"(embed_tokens|embedding|lm_head|word_embeddings)/(embedding|kernel)",
+         P(None, m)),
+    ])
+
+
+def lora_rules(base_rules: Callable, model_axis: str = "model") -> Callable:
+    """LoRA adapter sharding consistent with the base layout: the A factor
+    (in×r) follows the base kernel's input partitioning, the B factor (r×out)
+    its output partitioning. r is tiny → keep r replicated."""
+    match = getattr(base_rules, "match_str", None)
+
+    def rules(path, leaf) -> P:
+        s = path_str(path)
+        if match is not None and ("lora_a" in s or "lora_b" in s):
+            # Look up the spec the *base* kernel at this site would get
+            # (strip the adapter segment so 'q_proj/lora_a/kernel' matches
+            # the 'q_proj/kernel' rule), then inherit one of its dims.
+            base = match(s.replace("/lora_a", "").replace("/lora_b", ""),
+                         None)
+            if "lora_a" in s:  # A: (in, r) — inherit input-dim sharding
+                return P(base[0] if len(base) > 0 else None, None)
+            return P(None, base[1] if len(base) > 1 else None)  # B: (r, out)
+        return base_rules(path, leaf)
+
+    return rules

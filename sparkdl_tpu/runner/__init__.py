@@ -6,15 +6,15 @@ the compiled step; process topology is SPMD-per-host, not mpirun-per-slot.
 
 from .checkpoint import CheckpointManager, load_portable, save_portable
 from .metrics import MetricsLogger, ThroughputMeter, debug_mode, trace
-from .train_state import (TrainState, make_eval_step, make_shard_map_step,
-                          make_train_step, softmax_cross_entropy_loss,
-                          state_sharding)
+from .train_state import (TrainState, bn_classifier_loss, make_eval_step,
+                          make_shard_map_step, make_train_step,
+                          softmax_cross_entropy_loss, state_sharding)
 from .xla_runner import RunnerContext, XlaRunner, current_context
 
 __all__ = [
     "XlaRunner", "RunnerContext", "current_context",
     "TrainState", "make_train_step", "make_shard_map_step", "make_eval_step",
-    "state_sharding", "softmax_cross_entropy_loss",
+    "state_sharding", "softmax_cross_entropy_loss", "bn_classifier_loss",
     "CheckpointManager", "save_portable", "load_portable",
     "ThroughputMeter", "MetricsLogger", "trace", "debug_mode",
 ]

@@ -58,24 +58,15 @@ def shutdown():
 
 
 def allreduce(x, average: bool = True):
-    """Eager allreduce over the data axis — for out-of-step reductions
-    (metric aggregation). In-step gradient reduction should NOT use this; it
-    is compiled into the train step (see train_state.py)."""
+    """hvd.allreduce — for out-of-step reductions (metric aggregation).
+
+    Under a single controller every "rank" holds the same value already, so
+    the mean is the identity and the sum is ``x * size`` — no collective and
+    no compilation needed. In-step gradient reduction should NOT use this;
+    it is compiled into the train step (see train_state.py)."""
     ctx = _ctx()
-    n = ctx.size
     arr = jnp.asarray(x)
-    # A replicated-in, replicated-out sum over the sharded value: express as
-    # a jit over the mesh so XLA lowers it to one collective.
-    sh = ctx.data_sharding()
-
-    @jax.jit
-    def _sum(v):
-        return v.sum(axis=0)
-
-    stacked = jax.device_put(
-        jnp.broadcast_to(arr[None], (n,) + arr.shape), sh)
-    out = _sum(stacked)
-    return out / n if average else out
+    return arr if average else arr * ctx.size
 
 
 def broadcast(x, root_rank: int = 0):

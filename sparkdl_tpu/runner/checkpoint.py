@@ -16,6 +16,11 @@ from typing import Any
 import jax
 
 
+def _has_leaves(tree: Any) -> bool:
+    """Non-empty pytree check (truthiness would crash on array leaves)."""
+    return bool(jax.tree_util.tree_leaves(tree))
+
+
 class CheckpointManager:
     """Thin orbax wrapper pinned to the runner's needs.
 
@@ -40,6 +45,8 @@ class CheckpointManager:
             "opt_state": state.opt_state,
             "step": state.step,
         }
+        if _has_leaves(state.model_state):
+            payload["model_state"] = state.model_state
         self._mngr.save(step, args=ocp.args.StandardSave(payload))
         if wait:
             self._mngr.wait_until_finished()
@@ -61,11 +68,25 @@ class CheckpointManager:
             "opt_state": state_template.opt_state,
             "step": state_template.step,
         }
-        restored = self._mngr.restore(
-            step, args=ocp.args.StandardRestore(template))
+        if _has_leaves(state_template.model_state):
+            template["model_state"] = state_template.model_state
+        try:
+            restored = self._mngr.restore(
+                step, args=ocp.args.StandardRestore(template))
+        except ValueError:
+            if "model_state" not in template:
+                raise
+            # On-disk checkpoint predates model_state (saved by a
+            # non-mutable run): restore the rest, keep the template's fresh
+            # model_state.
+            template.pop("model_state")
+            restored = self._mngr.restore(
+                step, args=ocp.args.StandardRestore(template))
         return dataclasses.replace(
             state_template, params=restored["params"],
-            opt_state=restored["opt_state"], step=restored["step"])
+            opt_state=restored["opt_state"], step=restored["step"],
+            model_state=restored.get("model_state",
+                                     state_template.model_state))
 
     def wait(self):
         self._mngr.wait_until_finished()

@@ -92,17 +92,19 @@ class MetricsLogger:
                 log.warning("tensorboardX unavailable; metrics to log only")
 
     def log(self, step: int, metrics: dict):
+        """Emit to TB and the text log. Cadence is the caller's job (fit()
+        gates on log_every) — no re-gating here, or final/eval metrics at
+        off-cadence steps would be silently dropped."""
         if self._tb is not None:
             for k, v in metrics.items():
                 try:
                     self._tb.add_scalar(k, float(v), step)
                 except (TypeError, ValueError):
                     pass
-        if step % self.every == 0:
-            flat = {k: (round(float(v), 5)
-                        if isinstance(v, (int, float)) or hasattr(v, "item")
-                        else v) for k, v in metrics.items()}
-            log.info("step %d %s", step, json.dumps(flat, default=str))
+        flat = {k: (round(float(v), 5)
+                    if isinstance(v, (int, float)) or hasattr(v, "item")
+                    else v) for k, v in metrics.items()}
+        log.info("step %d %s", step, json.dumps(flat, default=str))
 
     def close(self):
         if self._tb is not None:

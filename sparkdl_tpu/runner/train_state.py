@@ -8,8 +8,10 @@ compiled program — either implicitly (``make_train_step``: batch sharded over
 the ``data`` mesh axis, params replicated, XLA's SPMD partitioner inserts the
 cross-chip reduce) or explicitly (``make_shard_map_step``: ``jax.lax.pmean``
 over the mesh axis under ``shard_map`` — the literal "psum over ICI" of the
-BASELINE north star). Both produce bit-identical updates; the explicit form
-exists so collective semantics are testable and visible.
+BASELINE north star). For stateless models the two produce identical
+updates; the explicit form exists so collective semantics are testable and
+visible. With ``mutable=True`` (BatchNorm) they intentionally differ — see
+the per-function docstrings.
 
 Design rules (TPU/XLA):
 - one compilation per (step_fn, shapes): state/batch shapes are static.
@@ -36,20 +38,26 @@ class TrainState:
     """Minimal functional train state (flax-style, dependency-free).
 
     ``apply_fn`` and ``tx`` are static (not traced); params/opt_state/step are
-    the pytree leaves that flow through the compiled step.
+    the pytree leaves that flow through the compiled step. ``model_state``
+    carries non-trainable collections (BatchNorm running stats) — updated by
+    the step, never differentiated.
     """
     step: jax.Array
     params: Any
     opt_state: Any
+    model_state: Any
     apply_fn: Callable = dataclasses.field(metadata=dict(static=True))
     tx: optax.GradientTransformation = dataclasses.field(
         metadata=dict(static=True))
 
     @classmethod
     def create(cls, apply_fn: Callable, params: Any,
-               tx: optax.GradientTransformation) -> "TrainState":
+               tx: optax.GradientTransformation,
+               model_state: Any = None) -> "TrainState":
         return cls(step=jnp.zeros((), jnp.int32), params=params,
-                   opt_state=tx.init(params), apply_fn=apply_fn, tx=tx)
+                   opt_state=tx.init(params),
+                   model_state={} if model_state is None else model_state,
+                   apply_fn=apply_fn, tx=tx)
 
     def apply_gradients(self, grads: Any) -> "TrainState":
         updates, new_opt = self.tx.update(grads, self.opt_state, self.params)
@@ -75,22 +83,45 @@ def state_sharding(state: TrainState, mesh: Mesh,
 
 def make_train_step(loss_fn: Callable, mesh: Mesh, data_axis: str = "data",
                     param_rules: Callable | None = None,
-                    donate: bool = True) -> Callable:
+                    donate: bool = True, mutable: bool = False,
+                    with_rng: bool = False, rng_seed: int = 0) -> Callable:
     """Compile an SPMD train step: ``step(state, batch) -> (state, metrics)``.
 
-    ``loss_fn(params, apply_fn, batch) -> (loss, aux_dict)``. The batch enters
-    sharded over ``data_axis``; params follow ``param_rules`` (default:
-    replicated = pure DP). The cross-chip gradient mean is inserted by XLA —
-    no explicit collective in user code.
+    ``loss_fn(params, apply_fn, batch) -> (loss, aux_dict)``; with
+    ``mutable=True`` (BatchNorm-style models):
+    ``loss_fn(params, model_state, apply_fn, batch) -> (loss, aux,
+    new_model_state)``. With ``with_rng=True`` the loss_fn additionally
+    receives ``rng=`` — a per-step PRNG key (folded from ``rng_seed`` by step
+    count) for dropout and other stochastic layers. The batch enters sharded
+    over ``data_axis``; params follow ``param_rules`` (default: replicated =
+    pure DP). The cross-chip gradient mean is inserted by XLA — no explicit
+    collective in user code. Under this path batch statistics reduce over the
+    *global* batch (sync-BN for free: the batch dim is sharded, the mean is
+    global).
     """
-    def step(state: TrainState, batch):
-        def loss_wrapped(params):
-            loss, aux = loss_fn(params, state.apply_fn, batch)
-            return loss.astype(jnp.float32), aux
+    base_key = jax.random.PRNGKey(rng_seed)
 
-        (loss, aux), grads = jax.value_and_grad(
-            loss_wrapped, has_aux=True)(state.params)
-        new_state = state.apply_gradients(grads)
+    def step(state: TrainState, batch):
+        kw = ({"rng": jax.random.fold_in(base_key, state.step)}
+              if with_rng else {})
+        if mutable:
+            def loss_wrapped(params):
+                loss, aux, new_ms = loss_fn(params, state.model_state,
+                                            state.apply_fn, batch, **kw)
+                return loss.astype(jnp.float32), (aux, new_ms)
+
+            (loss, (aux, new_ms)), grads = jax.value_and_grad(
+                loss_wrapped, has_aux=True)(state.params)
+            new_state = dataclasses.replace(
+                state.apply_gradients(grads), model_state=new_ms)
+        else:
+            def loss_wrapped(params):
+                loss, aux = loss_fn(params, state.apply_fn, batch, **kw)
+                return loss.astype(jnp.float32), aux
+
+            (loss, aux), grads = jax.value_and_grad(
+                loss_wrapped, has_aux=True)(state.params)
+            new_state = state.apply_gradients(grads)
         metrics = dict(loss=loss, **aux)
         return new_state, metrics
 
@@ -107,28 +138,56 @@ def make_train_step(loss_fn: Callable, mesh: Mesh, data_axis: str = "data",
 
 def make_shard_map_step(loss_fn: Callable, mesh: Mesh,
                         data_axis: str = "data",
-                        donate: bool = True) -> Callable:
+                        donate: bool = True,
+                        mutable: bool = False,
+                        with_rng: bool = False,
+                        rng_seed: int = 0) -> Callable:
     """The explicit-collective twin of ``make_train_step``.
 
     Runs per-shard forward/backward under ``shard_map`` and averages gradients
     with ``jax.lax.pmean`` over the mesh axis — the direct analogue of
     Horovod's ring-allreduce, except compiled into the XLA program so the
     collective overlaps with surrounding compute on ICI.
+
+    ``mutable=True`` note: BatchNorm here normalizes by *per-shard local*
+    batch statistics (each chip sees its own slice), and only the updated
+    running stats are pmean-ed — exactly Horovod's default (non-sync) BN.
+    The implicit ``make_train_step`` instead reduces batch stats over the
+    global batch (sync-BN). The two therefore diverge numerically for BN
+    models at small per-chip batch; pick by BN semantics, not by style.
     """
     shard_map = jax.shard_map
+    base_key = jax.random.PRNGKey(rng_seed)
 
     def per_shard(state: TrainState, batch):
-        def loss_wrapped(params):
-            loss, aux = loss_fn(params, state.apply_fn, batch)
-            return loss.astype(jnp.float32), aux
+        # Distinct dropout noise per shard: fold in the shard index too.
+        kw = ({"rng": jax.random.fold_in(
+            jax.random.fold_in(base_key, state.step),
+            jax.lax.axis_index(data_axis))} if with_rng else {})
+        if mutable:
+            def loss_wrapped(params):
+                loss, aux, new_ms = loss_fn(params, state.model_state,
+                                            state.apply_fn, batch, **kw)
+                return loss.astype(jnp.float32), (aux, new_ms)
 
-        (loss, aux), grads = jax.value_and_grad(
-            loss_wrapped, has_aux=True)(state.params)
+            (loss, (aux, new_ms)), grads = jax.value_and_grad(
+                loss_wrapped, has_aux=True)(state.params)
+            new_ms = jax.lax.pmean(new_ms, axis_name=data_axis)
+        else:
+            def loss_wrapped(params):
+                loss, aux = loss_fn(params, state.apply_fn, batch, **kw)
+                return loss.astype(jnp.float32), aux
+
+            (loss, aux), grads = jax.value_and_grad(
+                loss_wrapped, has_aux=True)(state.params)
+            new_ms = None
         # THE collective: gradient mean over the data axis (ICI ring).
         grads = jax.lax.pmean(grads, axis_name=data_axis)
         loss = jax.lax.pmean(loss, axis_name=data_axis)
         aux = jax.lax.pmean(aux, axis_name=data_axis)
         new_state = state.apply_gradients(grads)
+        if mutable:
+            new_state = dataclasses.replace(new_state, model_state=new_ms)
         return new_state, dict(loss=loss, **aux)
 
     def step(state, batch):
@@ -154,6 +213,30 @@ def make_eval_step(eval_fn: Callable, mesh: Mesh,
         return eval_fn(state.params, state.apply_fn, batch)
 
     return jax.jit(step)
+
+
+def bn_classifier_loss(model, preprocess: Callable | None = None,
+                       label_key: str = "label",
+                       input_key: str = "image") -> Callable:
+    """Stateful classification loss for flax BatchNorm models (use with
+    ``mutable=True`` steps): params = the 'params' collection; model_state
+    carries 'batch_stats', updated in train mode each step."""
+
+    def loss_fn(params, model_state, _apply_fn, batch):
+        variables = {"params": params, **model_state}
+        x = batch[input_key]
+        if preprocess is not None:
+            x = preprocess(x)
+        logits, new_vars = model.apply(variables, x, train=True,
+                                       mutable=["batch_stats"])
+        logits = logits.astype(jnp.float32)
+        labels = batch[label_key]
+        onehot = jax.nn.one_hot(labels, logits.shape[-1])
+        loss = optax.softmax_cross_entropy(logits, onehot).mean()
+        acc = (logits.argmax(-1) == labels).mean()
+        return loss, {"accuracy": acc.astype(jnp.float32)}, dict(new_vars)
+
+    return loss_fn
 
 
 def softmax_cross_entropy_loss(num_classes: int | None = None,

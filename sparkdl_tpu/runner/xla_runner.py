@@ -108,6 +108,8 @@ class RunnerContext:
     def fit(self, *, loss_fn: Callable, params: Any, tx,
             data: Iterable, num_steps: int,
             apply_fn: Callable | None = None,
+            model_state: Any = None, mutable: bool = False,
+            with_rng: bool = False,
             eval_fn: Callable | None = None, eval_data: Iterable | None = None,
             eval_every: int = 0, checkpoint_every: int = 0,
             log_every: int = 10, explicit_collectives: bool = False,
@@ -120,7 +122,8 @@ class RunnerContext:
         resumes from the latest checkpoint when ``resume`` and one exists —
         the checkpoint-and-restart failure-recovery story (SURVEY.md §5.3).
         """
-        state = TrainState.create(apply_fn or (lambda p, x: p), params, tx)
+        state = TrainState.create(apply_fn or (lambda p, x: p), params, tx,
+                                  model_state=model_state)
         start_step = 0
         if resume and self.checkpoints and \
                 self.checkpoints.latest_step() is not None:
@@ -135,7 +138,8 @@ class RunnerContext:
             lambda x: jax.device_put(np.asarray(x), rep), state)
 
         step_fn = self.make_train_step(
-            loss_fn, explicit_collectives=explicit_collectives)
+            loss_fn, explicit_collectives=explicit_collectives,
+            mutable=mutable, with_rng=with_rng)
         meter = self.meter()
         logger = metrics_lib.MetricsLogger(self.log_dir, every=log_every)
         eval_step = self.make_eval_step(eval_fn) if eval_fn else None

@@ -1,0 +1,216 @@
+"""BERT / Llama family tests: shapes, causality, LoRA masking, TP sharding
+equivalence, and ring-attention integration — all on the 8-device CPU mesh."""
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from sparkdl_tpu.core import runtime
+from sparkdl_tpu.models.bert import (BertConfig, BertEncoder,
+                                     BertForSequenceClassification,
+                                     glue_loss_fn)
+from sparkdl_tpu.models.llama import (LlamaConfig, LlamaModel,
+                                      causal_lm_loss_fn, lora_mask,
+                                      lora_optimizer)
+from sparkdl_tpu.parallel import (lora_rules, ring_attention, shard_params,
+                                  transformer_tp_rules)
+from sparkdl_tpu.runner import TrainState, XlaRunner
+
+
+def _bert_batch(cfg, B=8, S=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "input_ids": rng.randint(0, cfg.vocab_size, size=(B, S)),
+        "attention_mask": np.ones((B, S), np.int32),
+        "label": rng.randint(0, 2, size=(B,)),
+    }
+
+
+class TestBert:
+    def test_forward_shapes(self):
+        cfg = BertConfig.tiny()
+        model = BertEncoder(cfg)
+        ids = jnp.zeros((2, 16), jnp.int32)
+        variables = model.init(jax.random.PRNGKey(0), ids)
+        seq, pooled = model.apply(variables, ids)
+        assert seq.shape == (2, 16, cfg.hidden_size)
+        assert pooled.shape == (2, cfg.hidden_size)
+
+    def test_attention_mask_blocks_padding(self):
+        """Changing tokens under a zeroed mask must not change outputs."""
+        cfg = BertConfig.tiny()
+        model = BertEncoder(cfg)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, cfg.vocab_size, size=(1, 16))
+        mask = np.ones((1, 16), np.int32)
+        mask[:, 8:] = 0
+        variables = model.init(jax.random.PRNGKey(0), jnp.asarray(ids))
+        _, p1 = model.apply(variables, jnp.asarray(ids), jnp.asarray(mask))
+        ids2 = ids.copy()
+        ids2[:, 8:] = (ids2[:, 8:] + 7) % cfg.vocab_size
+        _, p2 = model.apply(variables, jnp.asarray(ids2), jnp.asarray(mask))
+        np.testing.assert_allclose(np.asarray(p1), np.asarray(p2),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_glue_finetune_learns(self):
+        """Config-4 shape: BERT classification fine-tune through the runner
+        on the 8-device mesh; loss must drop."""
+        cfg = BertConfig.tiny()
+        model = BertForSequenceClassification(cfg, num_classes=2)
+        batch0 = _bert_batch(cfg, B=16)
+        variables = jax.tree_util.tree_map(np.asarray, model.init(
+            jax.random.PRNGKey(0), jnp.asarray(batch0["input_ids"])))
+
+        def apply_fn(params, batch):
+            return model.apply(params, batch["input_ids"],
+                               batch["attention_mask"])
+
+        def data():
+            while True:
+                yield _bert_batch(cfg, B=16, seed=1)
+
+        res = XlaRunner(np=8).run(lambda ctx: ctx.fit(
+            loss_fn=glue_loss_fn(), params=variables,
+            tx=optax.adam(1e-3), apply_fn=apply_fn, data=data(),
+            num_steps=10, log_every=3))
+        losses = [h["loss"] for h in res["history"]]
+        assert losses[-1] < losses[0]
+
+
+class TestLlama:
+    def test_forward_and_causality(self):
+        cfg = LlamaConfig.tiny()
+        model = LlamaModel(cfg)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, cfg.vocab_size, size=(2, 16))
+        variables = model.init(jax.random.PRNGKey(0), jnp.asarray(ids))
+        logits = model.apply(variables, jnp.asarray(ids))
+        assert logits.shape == (2, 16, cfg.vocab_size)
+        # causality: mutate the last token — logits at positions < 15 fixed
+        ids2 = ids.copy()
+        ids2[:, -1] = (ids2[:, -1] + 3) % cfg.vocab_size
+        logits2 = model.apply(variables, jnp.asarray(ids2))
+        np.testing.assert_allclose(np.asarray(logits[:, :-1]),
+                                   np.asarray(logits2[:, :-1]),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_lora_mask_and_freeze(self):
+        cfg = LlamaConfig.tiny(lora_rank=4)
+        model = LlamaModel(cfg)
+        ids = jnp.zeros((1, 8), jnp.int32)
+        variables = model.init(jax.random.PRNGKey(0), ids)
+        mask = lora_mask(variables)
+        leaves = jax.tree_util.tree_leaves_with_path(variables)
+        n_lora = sum(bool(m) for m in jax.tree_util.tree_leaves(mask))
+        # 2 layers × (q_proj + v_proj) × (A + B) = 8 adapter leaves
+        assert n_lora == 8
+
+        # one optimizer step: base weights must be bit-identical after
+        state = TrainState.create(None, variables, lora_optimizer(1e-2))
+        grads = jax.tree_util.tree_map(jnp.ones_like, variables)
+        new = state.apply_gradients(grads)
+
+        from sparkdl_tpu.parallel.sharding import path_str
+        for (path, old), new_leaf in zip(
+                jax.tree_util.tree_leaves_with_path(variables),
+                jax.tree_util.tree_leaves(new.params)):
+            s = path_str(path)
+            if "lora" in s:
+                assert not np.allclose(np.asarray(old), np.asarray(new_leaf))
+            else:
+                np.testing.assert_array_equal(np.asarray(old),
+                                              np.asarray(new_leaf))
+
+    def test_lora_zero_init_is_identity(self):
+        """rank>0 with zero-init B must match the rank=0 model exactly
+        (same seed ⇒ same base weights)."""
+        ids = jnp.zeros((1, 8), jnp.int32)
+        m0 = LlamaModel(LlamaConfig.tiny())
+        m1 = LlamaModel(LlamaConfig.tiny(lora_rank=4))
+        v1 = m1.init(jax.random.PRNGKey(0), ids)
+        out1 = m1.apply(v1, ids)
+        # strip adapters, rename base params into the rank-0 structure
+        out0 = m0.apply(m0.init(jax.random.PRNGKey(0), ids), ids)
+        # flax init RNG folding differs once adapters exist, so compare
+        # through the B=0 algebra instead: adapters contribute (alpha/r)·xAB
+        # with B=0 ⇒ exact equality against the same v1 base weights.
+        from flax.traverse_util import flatten_dict, unflatten_dict
+        flat = {k: v for k, v in flatten_dict(v1, sep="/").items()
+                if "lora" not in k}
+        v0 = unflatten_dict({tuple(k.split("/")): v for k, v in flat.items()})
+        out_base = m0.apply(v0, ids)
+        np.testing.assert_allclose(np.asarray(out1), np.asarray(out_base),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_tp_sharding_equivalence(self):
+        """Llama forward with params sharded by transformer_tp_rules over a
+        2-D (data×model) mesh must equal the replicated forward."""
+        cfg = LlamaConfig.tiny()
+        model = LlamaModel(cfg)
+        rng = np.random.RandomState(1)
+        ids = jnp.asarray(rng.randint(0, cfg.vocab_size, size=(4, 16)))
+        variables = model.init(jax.random.PRNGKey(0), ids)
+        expected = model.apply(variables, ids)
+
+        mesh = runtime.make_mesh({"data": 4, "model": 2})
+        placed = shard_params(jax.tree_util.tree_map(np.asarray, variables),
+                              mesh, transformer_tp_rules())
+        # sanity: q_proj kernel is actually split over the model axis
+        q = placed["params"]["layer_0"]["attn"]["q_proj"]["base"]["kernel"]
+        assert {s.data.shape for s in q.addressable_shards} == {(128, 64)}
+
+        out = jax.jit(model.apply)(placed, ids)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_lora_tp_rules_on_real_params(self):
+        cfg = LlamaConfig.tiny(lora_rank=4)
+        model = LlamaModel(cfg)
+        ids = jnp.zeros((1, 8), jnp.int32)
+        variables = model.init(jax.random.PRNGKey(0), ids)
+        mesh = runtime.make_mesh({"data": 4, "model": 2})
+        placed = shard_params(jax.tree_util.tree_map(np.asarray, variables),
+                              mesh, lora_rules(transformer_tp_rules()))
+        b = placed["params"]["layer_0"]["attn"]["q_proj"]["lora_b"]["kernel"]
+        # B: (r, out) inherits output sharding → (4, 64) shards
+        assert {s.data.shape for s in b.addressable_shards} == {(4, 64)}
+
+    def test_ring_attention_integration(self):
+        """LlamaModel with sequence-parallel ring attention must match the
+        dense-attention model."""
+        cfg = LlamaConfig.tiny()
+        mesh = runtime.make_mesh({"sp": 8})
+        dense_model = LlamaModel(cfg)
+        ring_model = LlamaModel(cfg, attn_fn=functools.partial(
+            ring_attention, mesh=mesh, axis="sp"))
+        rng = np.random.RandomState(2)
+        ids = jnp.asarray(rng.randint(0, cfg.vocab_size, size=(2, 64)))
+        variables = dense_model.init(jax.random.PRNGKey(0), ids)
+        expected = dense_model.apply(variables, ids)
+        got = jax.jit(ring_model.apply)(variables, ids)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_causal_lm_loss_trains(self):
+        cfg = LlamaConfig.tiny(lora_rank=4)
+        model = LlamaModel(cfg)
+        rng = np.random.RandomState(3)
+        ids = rng.randint(0, cfg.vocab_size, size=(16, 16))
+        variables = jax.tree_util.tree_map(np.asarray, model.init(
+            jax.random.PRNGKey(0), jnp.asarray(ids)))
+
+        def data():
+            while True:
+                yield {"input_ids": ids}
+
+        res = XlaRunner(np=8).run(lambda ctx: ctx.fit(
+            loss_fn=causal_lm_loss_fn(), params=variables,
+            tx=lora_optimizer(5e-3),
+            apply_fn=model.apply,
+            data=data(), num_steps=8, log_every=2))
+        losses = [h["loss"] for h in res["history"]]
+        assert losses[-1] < losses[0]
