@@ -18,7 +18,8 @@ from .core import (DataFrame, Estimator, Evaluator, HasBatchSize, HasInputCol,
                    HasLabelCol, HasOutputCol, HasPredictionCol, HasSeed,
                    MLWritable, Model, Param, Params, Pipeline, PipelineModel,
                    Row, Transformer, TypeConverters, keyword_only, load)
-from .estimators import LogisticRegression, LogisticRegressionModel
+from .estimators import (KerasImageFileEstimator, LogisticRegression,
+                         LogisticRegressionModel)
 from .image.imageIO import imageSchema, readImages, readImagesWithCustomFn
 from .transformers import (DeepImageFeaturizer, DeepImagePredictor,
                            KerasImageFileTransformer, KerasTransformer,
@@ -42,6 +43,7 @@ __all__ = [
     "KerasImageFileTransformer", "XlaTransformer", "TFTransformer",
     "KerasTransformer",
     "LogisticRegression", "LogisticRegressionModel",
+    "KerasImageFileEstimator",
     "registerUDF", "registerImageUDF", "registerKerasImageUDF", "applyUDF",
     "listUDFs",
     "XlaRunner", "RunnerContext", "TrainState", "CheckpointManager",

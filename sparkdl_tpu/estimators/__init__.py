@@ -1,3 +1,5 @@
+from .keras_image_file_estimator import KerasImageFileEstimator
 from .logistic_regression import LogisticRegression, LogisticRegressionModel
 
-__all__ = ["LogisticRegression", "LogisticRegressionModel"]
+__all__ = ["LogisticRegression", "LogisticRegressionModel",
+           "KerasImageFileEstimator"]

@@ -1,0 +1,200 @@
+"""KerasImageFileEstimator — train a Keras model on an image-URI DataFrame.
+
+Reference: ``python/sparkdl/estimators/keras_image_file_estimator.py``
+(SURVEY.md §2.1, call stack §3.4): ``_getNumpyFeaturesAndLabels`` collected
+*all* image URIs to the driver, materialized the full dataset as numpy, and
+ran driver-side ``model.fit`` — a single-node bottleneck by design.
+
+TPU-native inversion (SURVEY.md §7.7): the dataset is **streamed** — images
+decode host-side per batch while the previous batch trains on the TPU
+(prefetch overlap), through the same compiled SPMD step machinery as
+XlaRunner (gradient allreduce inside the program, DP across all visible
+chips). Keras 3 on the JAX backend provides ``stateless_call`` so the Keras
+model trains as a pure jitted function; its weights never round-trip through
+Python during the loop. ``fitMultiple`` (hyperparameter parallelism) comes
+from the Estimator base class.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Iterator
+
+import numpy as np
+
+from ..core.frame import DataFrame
+from ..core.params import (HasBatchSize, HasInputCol, HasLabelCol,
+                           HasOutputCol, HasSeed, Param, Params,
+                           TypeConverters, keyword_only)
+from ..core.pipeline import Estimator
+from ..transformers.keras_image import KerasImageFileTransformer
+from ..transformers.payloads import PicklesCallableParams
+
+
+class KerasImageFileEstimator(PicklesCallableParams, Estimator, HasInputCol,
+                              HasOutputCol, HasLabelCol, HasBatchSize,
+                              HasSeed):
+    """Fits ``modelFile`` on (URI, label) rows; returns a
+    :class:`KerasImageFileTransformer` bound to the trained weights."""
+
+    modelFile = Param(Params, "modelFile",
+                      "path to a saved Keras model (.keras/.h5) to fine-tune",
+                      TypeConverters.toString)
+    imageLoader = Param(Params, "imageLoader",
+                        "callable uri -> float32 array (loads AND "
+                        "preprocesses)", TypeConverters.toCallable)
+    epochs = Param(Params, "epochs", "passes over the dataset",
+                   TypeConverters.toInt)
+    learningRate = Param(Params, "learningRate", "optimizer learning rate",
+                         TypeConverters.toFloat)
+    optimizer = Param(Params, "optimizer", "optax optimizer name "
+                      "(adam|sgd|adamw|rmsprop)", TypeConverters.toString)
+    loss = Param(Params, "loss", "loss: sparse_categorical_crossentropy | "
+                 "categorical_crossentropy | mse", TypeConverters.toString)
+    dropLastBatch = Param(Params, "dropLastBatch",
+                          "drop the trailing partial batch (keeps shapes "
+                          "static; set False to pad-and-mask it)",
+                          TypeConverters.toBoolean)
+
+    @keyword_only
+    def __init__(self, inputCol=None, outputCol=None, labelCol=None,
+                 modelFile=None, imageLoader=None, batchSize=None,
+                 epochs=None, learningRate=None, optimizer=None, loss=None,
+                 dropLastBatch=None, seed=None):
+        super().__init__()
+        self._setDefault(batchSize=32, epochs=1, learningRate=1e-3,
+                         optimizer="adam",
+                         loss="sparse_categorical_crossentropy",
+                         dropLastBatch=False, seed=0, labelCol="label")
+        self._set(**self._input_kwargs)
+
+    @keyword_only
+    def setParams(self, inputCol=None, outputCol=None, labelCol=None,
+                  modelFile=None, imageLoader=None, batchSize=None,
+                  epochs=None, learningRate=None, optimizer=None, loss=None,
+                  dropLastBatch=None, seed=None):
+        return self._set(**self._input_kwargs)
+
+    # -- data plane --------------------------------------------------------
+
+    def _batches(self, dataset: DataFrame, epochs: int) -> Iterator[dict]:
+        """Stream (image, label, weight) batches; images decoded lazily per
+        batch. The trailing partial batch is padded to the static batch size
+        with zero-weight rows (or dropped when ``dropLastBatch``)."""
+        in_col = self.getInputCol()
+        label_col = self.getLabelCol()
+        bs = self.getBatchSize()
+        loader = self.getOrDefault(self.imageLoader)
+        drop_last = self.getOrDefault(self.dropLastBatch)
+
+        for _ in range(epochs):
+            for rb in dataset.iterBatches(bs):
+                n = rb.num_rows
+                if n == 0 or (drop_last and n < bs):
+                    continue
+                uris = rb.column(in_col).to_pylist()
+                labels = np.asarray(rb.column(label_col).to_pylist())
+                imgs = np.stack([loader(u) for u in uris]).astype(np.float32)
+                weight = np.ones((n,), np.float32)
+                if n < bs:
+                    pad = bs - n
+                    imgs = np.concatenate(
+                        [imgs, np.broadcast_to(imgs[:1],
+                                               (pad,) + imgs.shape[1:])])
+                    labels = np.concatenate(
+                        [labels, np.broadcast_to(labels[:1],
+                                                 (pad,) + labels.shape[1:])])
+                    weight = np.concatenate([weight, np.zeros((pad,),
+                                                              np.float32)])
+                yield {"image": imgs, "label": labels, "weight": weight}
+
+    # -- training ----------------------------------------------------------
+
+    def _make_tx(self):
+        import optax
+        lr = self.getOrDefault(self.learningRate)
+        name = self.getOrDefault(self.optimizer).lower()
+        makers = {"adam": optax.adam, "sgd": optax.sgd, "adamw": optax.adamw,
+                  "rmsprop": optax.rmsprop}
+        if name not in makers:
+            raise ValueError(f"Unknown optimizer {name!r}; "
+                             f"one of {sorted(makers)}")
+        return makers[name](lr)
+
+    def _make_loss(self, model):
+        """Weighted loss over keras stateless_call — the ``mutable=True``
+        step contract (non-trainable vars = model_state)."""
+        import jax.numpy as jnp
+        name = self.getOrDefault(self.loss).lower()
+
+        def per_example(y, logits):
+            import optax
+            if name == "sparse_categorical_crossentropy":
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits.astype(jnp.float32), y.astype(jnp.int32))
+            if name == "categorical_crossentropy":
+                return optax.softmax_cross_entropy(
+                    logits.astype(jnp.float32), y.astype(jnp.float32))
+            if name == "mse":
+                d = logits.astype(jnp.float32) - y.astype(jnp.float32)
+                return d.reshape(d.shape[0], -1).mean(-1)
+            raise ValueError(f"Unknown loss {name!r}")
+
+        def loss_fn(params, model_state, _apply, batch):
+            out, new_nt = model.stateless_call(
+                params["trainable"], model_state["non_trainable"],
+                batch["image"], training=True)
+            le = per_example(batch["label"], out)
+            w = batch["weight"]
+            loss = (le * w).sum() / jnp.maximum(w.sum(), 1.0)
+            return loss, {}, {"non_trainable": new_nt}
+
+        return loss_fn
+
+    def _fit(self, dataset: DataFrame) -> KerasImageFileTransformer:
+        from ..runner import XlaRunner
+        from ..transformers.keras_utils import load_keras_model
+
+        model_file = self.getOrDefault(self.modelFile)
+        model = load_keras_model(model_file)
+        epochs = self.getOrDefault(self.epochs)
+        bs = self.getBatchSize()
+        n_rows = dataset.count()
+        if n_rows == 0:
+            raise ValueError("Cannot fit on an empty DataFrame")
+        per_epoch = (n_rows // bs if self.getOrDefault(self.dropLastBatch)
+                     else -(-n_rows // bs))
+        num_steps = max(per_epoch, 1) * epochs
+
+        params = {"trainable": [np.asarray(v.value)
+                                for v in model.trainable_variables]}
+        model_state = {"non_trainable": [np.asarray(v.value) for v in
+                                         model.non_trainable_variables]}
+
+        res = XlaRunner(np=-1).run(lambda ctx: ctx.fit(
+            loss_fn=self._make_loss(model), params=params,
+            tx=self._make_tx(), data=self._batches(dataset, epochs),
+            num_steps=num_steps, model_state=model_state, mutable=True,
+            log_every=max(num_steps // 4, 1)))
+
+        # Write trained weights back into the Keras model and persist it —
+        # the returned transformer is self-contained (reference semantics:
+        # the fitted transformer carries the trained model).
+        for var, val in zip(model.trainable_variables,
+                            res["state"].params["trainable"]):
+            var.assign(np.asarray(val))
+        for var, val in zip(model.non_trainable_variables,
+                            res["state"].model_state["non_trainable"]):
+            var.assign(np.asarray(val))
+        out_dir = tempfile.mkdtemp(prefix="sparkdl_keras_fit_")
+        trained_path = os.path.join(out_dir, "trained.keras")
+        model.save(trained_path)
+
+        return KerasImageFileTransformer(
+            inputCol=self.getInputCol(), outputCol=self.getOutputCol(),
+            modelFile=trained_path,
+            imageLoader=self.getOrDefault(self.imageLoader),
+            batchSize=bs)
+
+    _pickled_params = ("imageLoader",)

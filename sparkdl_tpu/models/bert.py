@@ -153,12 +153,8 @@ def glue_loss_fn():
     ``bert_finetune_loss`` with ``with_rng=True`` steps."""
     import optax
 
-    def loss_fn(params, apply_fn, batch, rng=None):
-        if rng is None:
-            logits = apply_fn(params, batch)
-        else:
-            logits = apply_fn(params, batch, rng)
-        logits = logits.astype(jnp.float32)
+    def loss_fn(params, apply_fn, batch):
+        logits = apply_fn(params, batch).astype(jnp.float32)
         onehot = jax.nn.one_hot(batch["label"], logits.shape[-1])
         loss = optax.softmax_cross_entropy(logits, onehot).mean()
         acc = (logits.argmax(-1) == batch["label"]).mean()

@@ -56,13 +56,9 @@ def _ring_shard(q, k, v, *, axis_name: str, causal: bool):
     perm = [(j, (j + 1) % ring) for j in range(ring)]
     q_pos = my_idx * T + jnp.arange(T)  # global query positions
 
-    def hop(i, carry):
-        o, m, l, kv = carry
-        kb, vb = kv
-        # After i forward rotations, the block we hold originated on ring
-        # neighbor (my_idx - i) mod ring — that index gives global key
+    def accumulate(o, m, l, kb, vb, src):
+        # ``src``: ring index the KV block originated on → global key
         # positions for causal masking.
-        src = (my_idx - i) % ring
         s = jnp.einsum("bhqd,bhkd->bhqk", qf, kb.astype(jnp.float32)) * scale
         if causal:
             k_pos = src * T + jnp.arange(T)
@@ -73,15 +69,22 @@ def _ring_shard(q, k, v, *, axis_name: str, causal: bool):
         l_new = l * corr + p.sum(axis=-1)
         o_new = o * corr[..., None] + jnp.einsum(
             "bhqk,bhkd->bhqd", p, vb.astype(jnp.float32))
-        # Rotate KV to the next ring neighbor; XLA overlaps this ppermute
-        # with the next hop's einsums (the ring-attention overlap trick).
-        kv_next = jax.lax.ppermute((kb, vb), axis_name, perm)
-        return o_new, m_new, l_new, kv_next
+        return o_new, m_new, l_new
+
+    def hop(i, carry):
+        o, m, l, kv = carry
+        # Rotate first, then accumulate: ring-1 ppermutes total (the local
+        # block was consumed before the loop), and XLA overlaps each
+        # ppermute with the previous iteration's einsums.
+        kv = jax.lax.ppermute(kv, axis_name, perm)
+        o, m, l = accumulate(o, m, l, *kv, src=(my_idx - (i + 1)) % ring)
+        return o, m, l, kv
 
     o0 = jnp.zeros((B, H, T, D), jnp.float32)
     m0 = jnp.full((B, H, T), NEG_INF, jnp.float32)
     l0 = jnp.zeros((B, H, T), jnp.float32)
-    o, m, l, _ = jax.lax.fori_loop(0, ring, hop, (o0, m0, l0, (k, v)))
+    o, m, l = accumulate(o0, m0, l0, k, v, src=my_idx)
+    o, m, l, _ = jax.lax.fori_loop(0, ring - 1, hop, (o, m, l, (k, v)))
     return (o / l[..., None]).astype(q.dtype)
 
 

@@ -96,7 +96,10 @@ def transformer_tp_rules(model_axis: str = "model",
       the follow-up matmul contracts locally and one psum restores the sum.
     - MLP: up-projection output-sharded, down-projection input-sharded —
       the classic pair that needs exactly one allreduce per block.
-    - embeddings/lm_head: vocab-sharded.
+    - embedding tables (vocab, hidden): hidden-dim sharded — GSPMD
+      all-gathers the looked-up rows, avoiding the masked-lookup+psum dance
+      of vocab-parallel embeddings; lm_head (hidden, vocab) is genuinely
+      vocab-sharded.
     - everything else (norms, biases): replicated.
 
     With ``data_axis`` set, 2-D FSDP-style layouts can extend these rules;

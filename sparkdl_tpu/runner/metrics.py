@@ -81,8 +81,7 @@ class MetricsLogger:
     """Scalar metrics sink: stdlib logging always; TensorBoard event files
     when a ``log_dir`` is given (via tensorboardX, SURVEY.md §5.5)."""
 
-    def __init__(self, log_dir: str | None = None, every: int = 10):
-        self.every = every
+    def __init__(self, log_dir: str | None = None):
         self._tb = None
         if log_dir:
             try:

@@ -102,6 +102,15 @@ def make_train_step(loss_fn: Callable, mesh: Mesh, data_axis: str = "data",
     base_key = jax.random.PRNGKey(rng_seed)
 
     def step(state: TrainState, batch):
+        if param_rules is not None:
+            # Pin the TP/FSDP layout inside the program: without the
+            # constraint XLA would keep whatever placement params arrived
+            # with (fully replicated for host arrays).
+            state = dataclasses.replace(
+                state, params=jax.tree_util.tree_map_with_path(
+                    lambda path, leaf: jax.lax.with_sharding_constraint(
+                        leaf, NamedSharding(mesh, param_rules(path, leaf))),
+                    state.params))
         kw = ({"rng": jax.random.fold_in(base_key, state.step)}
               if with_rng else {})
         if mutable:

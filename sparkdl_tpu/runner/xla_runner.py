@@ -141,7 +141,7 @@ class RunnerContext:
             loss_fn, explicit_collectives=explicit_collectives,
             mutable=mutable, with_rng=with_rng)
         meter = self.meter()
-        logger = metrics_lib.MetricsLogger(self.log_dir, every=log_every)
+        logger = metrics_lib.MetricsLogger(self.log_dir)
         eval_step = self.make_eval_step(eval_fn) if eval_fn else None
         history: list[dict] = []
 

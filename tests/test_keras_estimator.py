@@ -1,0 +1,95 @@
+"""KerasImageFileEstimator: streaming fit through the SPMD step machinery,
+trained-transformer round trip, fitMultiple hyperparameter parallelism."""
+
+import os
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu import DataFrame, KerasImageFileEstimator
+
+
+@pytest.fixture(scope="module")
+def keras_model_file(tmp_path_factory):
+    os.environ.setdefault("KERAS_BACKEND", "jax")
+    import keras
+    model = keras.Sequential([
+        keras.Input((8, 8, 3)),
+        keras.layers.Flatten(),
+        keras.layers.Dense(16, activation="relu"),
+        keras.layers.Dense(2),
+    ])
+    path = str(tmp_path_factory.mktemp("km") / "tiny.keras")
+    model.save(path)
+    return path
+
+
+def synthetic_loader(uri: str) -> np.ndarray:
+    """'img_<label>_<i>' → image whose pixel values encode the label
+    (linearly separable, so a couple of epochs suffice)."""
+    label = int(uri.split("_")[1])
+    rng = np.random.RandomState(abs(hash(uri)) % (2 ** 31))
+    return (np.full((8, 8, 3), float(label)) +
+            rng.randn(8, 8, 3) * 0.1).astype(np.float32)
+
+
+def _df(n=48, partitions=3):
+    rows = [{"uri": f"img_{i % 2}_{i}", "label": i % 2} for i in range(n)]
+    return DataFrame.fromRows(rows, numPartitions=partitions)
+
+
+class TestKerasImageFileEstimator:
+    def test_fit_learns_and_returns_transformer(self, keras_model_file):
+        est = KerasImageFileEstimator(
+            inputCol="uri", outputCol="scores", labelCol="label",
+            modelFile=keras_model_file, imageLoader=synthetic_loader,
+            batchSize=16, epochs=4, learningRate=5e-2)
+        df = _df()
+        model = est.fit(df)
+
+        out = model.transform(df).toPandas()
+        scores = np.stack(out["scores"].to_numpy())
+        preds = scores.argmax(-1)
+        labels = out["label"].to_numpy()
+        acc = (preds == labels).mean()
+        assert acc >= 0.9, f"accuracy {acc} — training did not learn"
+
+    def test_partial_batch_padding_matches_drop(self, keras_model_file):
+        """48 rows with batchSize=20: padded partial batches must still
+        train without shape errors (static shapes preserved)."""
+        est = KerasImageFileEstimator(
+            inputCol="uri", outputCol="scores", labelCol="label",
+            modelFile=keras_model_file, imageLoader=synthetic_loader,
+            batchSize=16, epochs=1)
+        # 40 rows → batches of 16,16,8(padded)
+        model = est.fit(_df(n=40))
+        assert model is not None
+
+    def test_fit_empty_raises(self, keras_model_file):
+        est = KerasImageFileEstimator(
+            inputCol="uri", outputCol="scores", labelCol="label",
+            modelFile=keras_model_file, imageLoader=synthetic_loader)
+        with pytest.raises(ValueError):
+            est.fit(DataFrame.fromRows([], numPartitions=1))
+
+    def test_fit_multiple_order(self, keras_model_file):
+        """fit(df, [maps]) returns models in paramMaps order even though
+        fitMultiple completes out of order."""
+        est = KerasImageFileEstimator(
+            inputCol="uri", outputCol="scores", labelCol="label",
+            modelFile=keras_model_file, imageLoader=synthetic_loader,
+            batchSize=16, epochs=1)
+        df = _df(n=32)
+        maps = [{est.epochs: 1}, {est.epochs: 2}]
+        models = est.fit(df, maps)
+        assert len(models) == 2
+        for m in models:
+            assert m.transform(df).count() == 32
+
+    def test_bad_optimizer_raises(self, keras_model_file):
+        est = KerasImageFileEstimator(
+            inputCol="uri", outputCol="s", labelCol="label",
+            modelFile=keras_model_file, imageLoader=synthetic_loader,
+            optimizer="lion9000")
+        with pytest.raises(ValueError):
+            est.fit(_df(n=16))

@@ -167,6 +167,28 @@ class TestLlama:
         np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
                                    rtol=2e-4, atol=2e-5)
 
+    def test_train_step_param_rules_pins_tp_layout(self):
+        """make_train_step(param_rules=...) must emit params sharded per the
+        rules, even when inputs arrive replicated."""
+        from sparkdl_tpu.runner import TrainState, make_train_step
+        cfg = LlamaConfig.tiny()
+        model = LlamaModel(cfg)
+        rng = np.random.RandomState(5)
+        ids = jnp.asarray(rng.randint(0, cfg.vocab_size, size=(4, 16)))
+        variables = jax.tree_util.tree_map(np.asarray, model.init(
+            jax.random.PRNGKey(0), ids))
+        mesh = runtime.make_mesh({"data": 4, "model": 2})
+        state = TrainState.create(model.apply, variables, optax.sgd(1e-2))
+        step = make_train_step(causal_lm_loss_fn(), mesh, data_axis="data",
+                               param_rules=transformer_tp_rules())
+        with mesh:
+            new_state, m = step(state, {"input_ids": ids})
+        q = new_state.params["params"]["layer_0"]["attn"]["q_proj"]["base"][
+            "kernel"]
+        # output (hidden=128) dim split over model axis (2) → (128, 64)
+        assert {s.data.shape for s in q.addressable_shards} == {(128, 64)}
+        assert np.isfinite(float(m["loss"]))
+
     def test_lora_tp_rules_on_real_params(self):
         cfg = LlamaConfig.tiny(lora_rank=4)
         model = LlamaModel(cfg)
