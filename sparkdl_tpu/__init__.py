@@ -20,6 +20,9 @@ from .core import (DataFrame, Estimator, Evaluator, HasBatchSize, HasInputCol,
                    Row, Transformer, TypeConverters, keyword_only, load)
 from .estimators import (KerasImageFileEstimator, LogisticRegression,
                          LogisticRegressionModel)
+from .graph import (GraphFunction, IsolatedSession, TFInputGraph,
+                    XlaInputGraph, buildFlattener, buildSpImageConverter,
+                    makeGraphUDF)
 from .image.imageIO import imageSchema, readImages, readImagesWithCustomFn
 from .transformers import (DeepImageFeaturizer, DeepImagePredictor,
                            KerasImageFileTransformer, KerasTransformer,
@@ -46,6 +49,8 @@ __all__ = [
     "KerasImageFileEstimator",
     "registerUDF", "registerImageUDF", "registerKerasImageUDF", "applyUDF",
     "listUDFs",
+    "GraphFunction", "IsolatedSession", "XlaInputGraph", "TFInputGraph",
+    "buildSpImageConverter", "buildFlattener", "makeGraphUDF",
     "XlaRunner", "RunnerContext", "TrainState", "CheckpointManager",
     "make_train_step", "make_shard_map_step",
     "__version__",

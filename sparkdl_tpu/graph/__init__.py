@@ -1,0 +1,19 @@
+"""Graph toolkit — the reference's L2 layer, rebuilt on jax.export/StableHLO.
+
+Reference: ``python/sparkdl/graph/`` (builder, input, pieces, utils,
+tensorframes_udf) — SURVEY.md §1-L2/§2.1.
+"""
+
+from .builder import GraphNode, IsolatedGraph, IsolatedSession
+from .function import GraphFunction
+from .input import TFInputGraph, XlaInputGraph, load_weights
+from .pieces import buildFlattener, buildSpImageConverter
+from .udf import makeGraphUDF
+from .utils import op_name, tensor_name, validated_input, validated_output
+
+__all__ = [
+    "GraphFunction", "IsolatedSession", "IsolatedGraph", "GraphNode",
+    "XlaInputGraph", "TFInputGraph", "load_weights",
+    "buildSpImageConverter", "buildFlattener", "makeGraphUDF",
+    "op_name", "tensor_name", "validated_input", "validated_output",
+]

@@ -1,0 +1,306 @@
+"""GraphFunction — the portable unit of compiled compute.
+
+Reference surface: ``python/sparkdl/graph/builder.py``'s ``GraphFunction`` — a
+serialized TF GraphDef plus input/output tensor names, buildable from Keras
+models or by chaining pieces (``fromList``), spliced into sessions with
+``importGraphFunction`` (SURVEY.md §2.1/§3.3).
+
+TPU-native re-design: the portable artifact is **StableHLO via jax.export**,
+not a GraphDef — a ``GraphFunction`` is a jit-traceable function with *named*
+feeds and fetches (weights closed over as constants), which:
+
+- executes as one XLA program (``.jit()``), so composed pieces fuse;
+- composes functionally (``fromList`` chains fetches→feeds positionally, the
+  reference's piece-chaining semantic) — composition happens before tracing,
+  so XLA sees a single graph, where the reference spliced GraphDefs;
+- serializes to bytes (``serialize``/``deserialize``, ``dump``/``load``) with
+  a symbolic leading batch dimension, the analogue of the reference's
+  portable GraphDef payloads.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Mapping, Sequence
+
+import numpy as np
+
+from .utils import op_name, validated_output
+
+_MAGIC = b"SPARKDL-TPU-GFN1"
+
+
+class GraphFunction:
+    """A named-feeds/named-fetches jittable function.
+
+    ``fn`` maps a dict ``{input_name: array}`` to a dict
+    ``{output_name: array}`` and must be jax-traceable (any captured weights
+    become XLA constants at compile/serialize time).
+    """
+
+    def __init__(self, fn: Callable[[dict], dict],
+                 input_names: Sequence[str], output_names: Sequence[str],
+                 input_specs: Mapping[str, tuple] | None = None):
+        self.fn = fn
+        self.input_names = [op_name(n) for n in input_names]
+        self.output_names = [op_name(n) for n in output_names]
+        # {name: (shape_with_None_batch, dtype_str)} — needed only to
+        # serialize; calls infer shapes from the actual feeds.
+        self.input_specs = dict(input_specs) if input_specs else None
+        self._jitted = None
+
+    # -- execution ---------------------------------------------------------
+
+    def __call__(self, feeds: Mapping[str, object] | None = None, **kw):
+        fetches = self.fn(self._normalize_feeds(feeds, kw))
+        return {op_name(k): v for k, v in fetches.items()}
+
+    def jit(self) -> Callable:
+        """The compiled entry point: dict feeds → dict fetches, one XLA
+        program per feed-shape signature."""
+        if self._jitted is None:
+            import jax
+            self._jitted = jax.jit(
+                lambda feeds: self.fn(feeds))
+        jitted = self._jitted
+        normalize = self._normalize_feeds
+        return lambda feeds=None, **kw: jitted(normalize(feeds, kw))
+
+    def as_single_output_fn(self, fetch: str | None = None) -> Callable:
+        """batch → array adapter for single-input/single-output use (the
+        shape the transformer/UDF layer consumes)."""
+        if len(self.input_names) != 1:
+            raise ValueError(
+                f"as_single_output_fn needs exactly one input, have "
+                f"{self.input_names}")
+        out = (validated_output(fetch, self.output_names) if fetch
+               else self.output_names[-1])
+        name = self.input_names[0]
+        fn = self.fn
+        return lambda batch: fn({name: batch})[out]
+
+    def _normalize_feeds(self, feeds, kw) -> dict:
+        merged = dict(feeds or {})
+        merged.update(kw)
+        named = {op_name(k): v for k, v in merged.items()}
+        missing = [n for n in self.input_names if n not in named]
+        if missing:
+            raise ValueError(f"Missing feeds {missing}; expected "
+                             f"{self.input_names}")
+        extra = [n for n in named if n not in self.input_names]
+        if extra:
+            raise ValueError(f"Unknown feeds {extra}; expected "
+                             f"{self.input_names}")
+        return named
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def fromJax(cls, fn: Callable, input_names: Sequence[str] | None = None,
+                output_names: Sequence[str] | None = None,
+                input_specs: Mapping[str, tuple] | None = None
+                ) -> "GraphFunction":
+        """Wrap a jax function taking positional arrays (one per input name)
+        and returning an array, tuple of arrays, or dict of arrays."""
+        inputs = [op_name(n) for n in (input_names or ["input"])]
+        declared = [op_name(n) for n in output_names] if output_names else None
+
+        def wrapped(feeds: dict) -> dict:
+            out = fn(*[feeds[n] for n in inputs])
+            return _name_outputs(out, declared)
+
+        outputs = declared or _probe_output_names(fn, inputs, input_specs)
+        return cls(wrapped, inputs, outputs, input_specs)
+
+    @classmethod
+    def fromKeras(cls, model_or_file, input_name: str = "input",
+                  output_name: str = "output") -> "GraphFunction":
+        """A Keras-3 (jax backend) model or saved .keras/.h5 file → one
+        GraphFunction (weights captured). Reference: GraphFunction.fromKeras
+        exported K.get_session()'s graph."""
+        from ..transformers.keras_utils import (keras_model_to_fn,
+                                                load_keras_model)
+        model = (load_keras_model(model_or_file)
+                 if isinstance(model_or_file, (str, os.PathLike))
+                 else model_or_file)
+        fn = keras_model_to_fn(model)
+        spec = None
+        try:
+            shape = tuple(model.inputs[0].shape)
+            spec = {op_name(input_name): (shape, "float32")}
+        except Exception:
+            pass
+        return cls.fromJax(fn, [input_name], [output_name], spec)
+
+    @classmethod
+    def fromFlax(cls, module, variables, input_name: str = "input",
+                 output_name: str = "output", **apply_kwargs
+                 ) -> "GraphFunction":
+        """A flax ``nn.Module`` + variables pytree → GraphFunction (weights
+        captured as constants)."""
+        def fn(batch):
+            return module.apply(variables, batch, **apply_kwargs)
+        return cls.fromJax(fn, [input_name], [output_name])
+
+    @classmethod
+    def fromList(cls, functions: Sequence["GraphFunction"]) -> "GraphFunction":
+        """Chain pieces: stage i's fetches feed stage i+1's feeds
+        positionally (the reference's piece-composition contract). The
+        composite exposes the first stage's feeds and last stage's fetches —
+        and compiles to ONE fused XLA program."""
+        if not functions:
+            raise ValueError("fromList needs at least one GraphFunction")
+        for a, b in zip(functions, functions[1:]):
+            if len(a.output_names) != len(b.input_names):
+                raise ValueError(
+                    f"Cannot chain: stage with outputs {a.output_names} into "
+                    f"stage with inputs {b.input_names} (arity mismatch)")
+        stages = list(functions)
+
+        def chained(feeds: dict) -> dict:
+            values = feeds
+            for i, g in enumerate(stages):
+                if i > 0:
+                    prev = stages[i - 1]
+                    values = {bn: values[an] for an, bn in
+                              zip(prev.output_names, g.input_names)}
+                values = g.fn(values)
+                values = {op_name(k): v for k, v in values.items()}
+            return values
+
+        return cls(chained, stages[0].input_names, stages[-1].output_names,
+                   stages[0].input_specs)
+
+    def then(self, other: "GraphFunction") -> "GraphFunction":
+        return GraphFunction.fromList([self, other])
+
+    def rename(self, inputs: Mapping[str, str] | None = None,
+               outputs: Mapping[str, str] | None = None) -> "GraphFunction":
+        imap = {op_name(k): op_name(v) for k, v in (inputs or {}).items()}
+        omap = {op_name(k): op_name(v) for k, v in (outputs or {}).items()}
+        new_in = [imap.get(n, n) for n in self.input_names]
+        new_out = [omap.get(n, n) for n in self.output_names]
+        inv_in = dict(zip(new_in, self.input_names))
+        fn = self.fn
+
+        def renamed(feeds: dict) -> dict:
+            out = fn({inv_in[k]: v for k, v in feeds.items()})
+            return {omap.get(op_name(k), op_name(k)): v
+                    for k, v in out.items()}
+
+        specs = ({imap.get(k, k): v for k, v in self.input_specs.items()}
+                 if self.input_specs else None)
+        return GraphFunction(renamed, new_in, new_out, specs)
+
+    # -- serialization (StableHLO via jax.export) --------------------------
+
+    def serialize(self, input_specs: Mapping[str, tuple] | None = None
+                  ) -> bytes:
+        """→ portable bytes: json header (names/specs) + jax.export payload.
+
+        ``input_specs``: {name: (shape, dtype)}; a ``None`` leading dim
+        becomes a symbolic batch dimension so any batch size can be fed at
+        load time. Falls back to specs captured at construction.
+        """
+        import jax
+        from jax import export as jex
+
+        specs = dict(input_specs or self.input_specs or {})
+        missing = [n for n in self.input_names if n not in specs]
+        if missing:
+            raise ValueError(
+                f"serialize needs input_specs for {missing} "
+                f"(shape, dtype per input)")
+
+        # One shared symbol for every leading None (batch — inputs batch
+        # together); a distinct symbol per other variable dim. All symbols
+        # must live in ONE scope, so name them first and mint them together.
+        sym_names: dict = {}
+        for n in self.input_names:
+            for axis, d in enumerate(specs[n][0]):
+                if d is None:
+                    key = "batch" if axis == 0 else (n, axis)
+                    sym_names.setdefault(key, f"d{len(sym_names) + 1}")
+        symbols = (dict(zip(sym_names, jex.symbolic_shape(
+            ", ".join(sym_names.values())))) if sym_names else {})
+
+        def to_sds(name, shape, dtype):
+            dims = [symbols["batch" if axis == 0 else (name, axis)]
+                    if d is None else int(d)
+                    for axis, d in enumerate(shape)]
+            return jax.ShapeDtypeStruct(tuple(dims), np.dtype(dtype))
+
+        sds = [to_sds(n, *specs[n]) for n in self.input_names]
+        inputs, outputs, fn = self.input_names, self.output_names, self.fn
+
+        def positional(*args):
+            res = fn(dict(zip(inputs, args)))
+            return tuple(res[n] for n in outputs)
+
+        exported = jex.export(jax.jit(positional))(*sds)
+        header = json.dumps({
+            "inputs": inputs, "outputs": outputs,
+            "specs": {n: [list(specs[n][0]), str(np.dtype(specs[n][1]))]
+                      for n in inputs},
+        }).encode()
+        payload = exported.serialize()
+        return (_MAGIC + len(header).to_bytes(8, "little") + header + payload)
+
+    @classmethod
+    def deserialize(cls, data: bytes) -> "GraphFunction":
+        from jax import export as jex
+        if data[:len(_MAGIC)] != _MAGIC:
+            raise ValueError("Not a serialized GraphFunction")
+        off = len(_MAGIC)
+        hlen = int.from_bytes(data[off:off + 8], "little")
+        header = json.loads(data[off + 8:off + 8 + hlen])
+        exported = jex.deserialize(data[off + 8 + hlen:])
+        inputs, outputs = header["inputs"], header["outputs"]
+
+        def fn(feeds: dict) -> dict:
+            res = exported.call(*[feeds[n] for n in inputs])
+            return dict(zip(outputs, res))
+
+        specs = {n: (tuple(s if s is None else int(s) for s in shape), dt)
+                 for n, (shape, dt) in header.get("specs", {}).items()}
+        return cls(fn, inputs, outputs, specs or None)
+
+    def dump(self, path: str, input_specs: Mapping[str, tuple] | None = None):
+        data = self.serialize(input_specs)
+        with open(path, "wb") as f:
+            f.write(data)
+
+    @classmethod
+    def load(cls, path: str) -> "GraphFunction":
+        with open(path, "rb") as f:
+            return cls.deserialize(f.read())
+
+    def __repr__(self):
+        return (f"GraphFunction(inputs={self.input_names}, "
+                f"outputs={self.output_names})")
+
+
+def _name_outputs(out, declared: Sequence[str] | None) -> dict:
+    if isinstance(out, dict):
+        named = {op_name(k): v for k, v in out.items()}
+        if declared and sorted(named) != sorted(declared):
+            raise ValueError(f"Function returned outputs {sorted(named)}, "
+                             f"declared {sorted(declared)}")
+        return named
+    vals = out if isinstance(out, (tuple, list)) else (out,)
+    if declared is None and len(vals) > 1:
+        raise ValueError(
+            "Multi-output functions must declare output_names or return a "
+            "dict of named outputs")
+    names = declared or ["output"]
+    if len(names) != len(vals):
+        raise ValueError(f"Function returned {len(vals)} outputs, declared "
+                         f"{len(names)} names {names}")
+    return dict(zip(names, vals))
+
+
+def _probe_output_names(fn, inputs, input_specs) -> list[str]:
+    # Without declared names or a dict return we can't know the output names
+    # until traced; default single-output name keeps the common case simple.
+    return ["output"]

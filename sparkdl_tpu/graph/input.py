@@ -1,0 +1,265 @@
+"""XlaInputGraph — normalize any model artifact into a GraphFunction.
+
+Reference surface: ``python/sparkdl/graph/input.py``'s ``TFInputGraph`` with
+``fromGraph``/``fromGraphDef``/``fromSavedModel``/``fromCheckpoint``
+(+``WithSignature`` variants) — one constructor per TF-1.x artifact kind, all
+normalizing to (graphdef, feeds, fetches) (SURVEY.md §2.1).
+
+TPU-native re-design: the native artifact kinds are jax-world — functions,
+flax modules + pytrees, Keras-3(jax) models, serialized StableHLO
+(``GraphFunction.dump``), and weight checkpoints (orbax/safetensors/h5).
+Legacy TF artifacts (SavedModel, frozen GraphDef, TF checkpoints) remain
+loadable through a compat bridge: the TF graph is pruned to feeds/fetches and
+embedded via ``jax2tf.call_tf`` — callable from jax, compiled by XLA — so
+reference users' existing exported models still run. The bridge requires the
+CPU backend (TF kernels); everything else compiles for TPU.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Mapping, Sequence
+
+from .function import GraphFunction
+from .utils import op_name, tensor_name
+
+
+class XlaInputGraph:
+    """A normalized (GraphFunction, feeds, fetches) triple."""
+
+    def __init__(self, gfn: GraphFunction):
+        self.gfn = gfn
+
+    @property
+    def input_names(self) -> list[str]:
+        return self.gfn.input_names
+
+    @property
+    def output_names(self) -> list[str]:
+        return self.gfn.output_names
+
+    def translateToGraphFunction(self) -> GraphFunction:
+        return self.gfn
+
+    asGraphFunction = translateToGraphFunction
+
+    # ---- native jax-world artifacts --------------------------------------
+
+    @classmethod
+    def fromGraph(cls, fn: Callable, feed_names: Sequence[str] | None = None,
+                  fetch_names: Sequence[str] | None = None) -> "XlaInputGraph":
+        """A jax-traceable function (the 'live graph' of this world)."""
+        return cls(GraphFunction.fromJax(fn, feed_names, fetch_names))
+
+    @classmethod
+    def fromGraphFunction(cls, gfn: GraphFunction) -> "XlaInputGraph":
+        return cls(gfn)
+
+    @classmethod
+    def fromSerialized(cls, path_or_bytes) -> "XlaInputGraph":
+        """A ``GraphFunction.dump`` artifact (StableHLO) — the analogue of
+        loading a frozen GraphDef file."""
+        if isinstance(path_or_bytes, (bytes, bytearray)):
+            return cls(GraphFunction.deserialize(bytes(path_or_bytes)))
+        return cls(GraphFunction.load(os.fspath(path_or_bytes)))
+
+    @classmethod
+    def fromKeras(cls, model_or_file) -> "XlaInputGraph":
+        return cls(GraphFunction.fromKeras(model_or_file))
+
+    @classmethod
+    def fromFlax(cls, module, variables, **apply_kwargs) -> "XlaInputGraph":
+        return cls(GraphFunction.fromFlax(module, variables, **apply_kwargs))
+
+    @classmethod
+    def fromCheckpoint(cls, checkpoint_path: str, model_fn: Callable,
+                       input_name: str = "input",
+                       output_name: str = "output") -> "XlaInputGraph":
+        """Weights-at-rest + a model function → GraphFunction.
+
+        ``checkpoint_path``: an orbax checkpoint dir, a ``.safetensors``
+        file, a Keras ``.h5``/``.weights.h5`` file, or a TF checkpoint
+        prefix. ``model_fn(params, batch)`` binds them. (The reference's
+        ``fromCheckpoint`` instead pulled the graph out of the colocated
+        meta-graph — jax separates weights from program, so the program must
+        be supplied.)
+        """
+        params = load_weights(checkpoint_path)
+        return cls(GraphFunction.fromJax(
+            lambda batch: model_fn(params, batch),
+            [input_name], [output_name]))
+
+    # ---- TF-era compat bridge (jax2tf.call_tf) ---------------------------
+
+    @classmethod
+    def fromSavedModel(cls, saved_model_dir: str,
+                       signature: str = "serving_default",
+                       feed_names: Sequence[str] | None = None,
+                       fetch_names: Sequence[str] | None = None
+                       ) -> "XlaInputGraph":
+        """TF-2 SavedModel → GraphFunction via jax2tf.call_tf (CPU backend).
+
+        Reference parity: ``TFInputGraph.fromSavedModel(WithSignature)`` —
+        the signature's structured inputs/outputs become the feeds/fetches.
+        """
+        import tensorflow as tf
+        from jax.experimental import jax2tf
+
+        loaded = tf.saved_model.load(saved_model_dir)
+        try:
+            sig = loaded.signatures[signature]
+        except KeyError:
+            raise ValueError(
+                f"SavedModel has no signature {signature!r}; available: "
+                f"{list(loaded.signatures)}") from None
+        in_keys = sorted(sig.structured_input_signature[1])
+        out_keys = sorted(sig.structured_outputs)
+        # feed/fetch names select BY NAME from the signature (never
+        # positionally): they must be signature keys.
+        feeds = [op_name(n) for n in feed_names] if feed_names else in_keys
+        fetches = ([op_name(n) for n in fetch_names] if fetch_names
+                   else out_keys)
+        for n in feeds:
+            if n not in in_keys:
+                raise ValueError(f"Feed {n!r} is not a signature input; "
+                                 f"inputs: {in_keys}")
+        for n in fetches:
+            if n not in out_keys:
+                raise ValueError(f"Fetch {n!r} is not a signature output; "
+                                 f"outputs: {out_keys}")
+        if set(feeds) != set(in_keys):
+            raise ValueError(
+                f"All signature inputs must be fed; missing "
+                f"{sorted(set(in_keys) - set(feeds))}")
+        call = jax2tf.call_tf(
+            lambda *args: sig(**dict(zip(in_keys, args))))
+        # keep a reference to the loaded object alive in the closure
+        def fn(feeds_dict: dict) -> dict:
+            _ = loaded
+            out = call(*[feeds_dict[n] for n in in_keys])
+            return {f: out[f] for f in fetches}
+
+        return cls(GraphFunction(fn, feeds, fetches))
+
+    @classmethod
+    def fromSavedModelWithSignature(cls, saved_model_dir: str,
+                                    signature_def_key: str
+                                    ) -> "XlaInputGraph":
+        return cls.fromSavedModel(saved_model_dir,
+                                  signature=signature_def_key)
+
+    @classmethod
+    def fromGraphDef(cls, graph_def, feed_names: Sequence[str],
+                     fetch_names: Sequence[str]) -> "XlaInputGraph":
+        """A frozen TF GraphDef (proto or serialized bytes) pruned to
+        feeds/fetches, embedded via jax2tf.call_tf."""
+        import tensorflow as tf
+        from jax.experimental import jax2tf
+
+        if isinstance(graph_def, (bytes, bytearray)):
+            gd = tf.compat.v1.GraphDef()
+            gd.ParseFromString(bytes(graph_def))
+            graph_def = gd
+        wrapped = tf.compat.v1.wrap_function(
+            lambda: tf.graph_util.import_graph_def(graph_def, name=""), [])
+        pruned = wrapped.prune(
+            feeds=[wrapped.graph.get_tensor_by_name(tensor_name(n))
+                   for n in feed_names],
+            fetches=[wrapped.graph.get_tensor_by_name(tensor_name(n))
+                     for n in fetch_names])
+        call = jax2tf.call_tf(pruned)
+        feeds = [op_name(n) for n in feed_names]
+        fetches = [op_name(n) for n in fetch_names]
+
+        def fn(feeds_dict: dict) -> dict:
+            out = call(*[feeds_dict[n] for n in feeds])
+            if not isinstance(out, (tuple, list)):
+                out = (out,)
+            return dict(zip(fetches, out))
+
+        return cls(GraphFunction(fn, feeds, fetches))
+
+
+TFInputGraph = XlaInputGraph  # reference-compat alias
+
+
+# ---------------------------------------------------------------------------
+# Weight loading (offline formats; SURVEY.md §7 "weight import offline")
+# ---------------------------------------------------------------------------
+
+def load_weights(path: str) -> Mapping:
+    """Checkpoint file/dir → pytree (dict) of numpy arrays.
+
+    Supports: orbax checkpoint dirs, .safetensors, Keras .h5 weight files,
+    .npz, and TF2 checkpoints (prefix with .index beside it).
+    """
+    path = os.fspath(path)
+    if os.path.isdir(path):
+        if any(n.startswith("ocdbt") or n in ("_METADATA", "manifest.ocdbt")
+               or n.endswith(".orbax-checkpoint") or n == "_CHECKPOINT_METADATA"
+               for n in os.listdir(path)) or _looks_like_orbax(path):
+            import orbax.checkpoint as ocp
+            with ocp.PyTreeCheckpointer() as ckptr:
+                return ckptr.restore(path)
+        raise ValueError(f"Unrecognized checkpoint directory {path!r}")
+    if path.endswith(".safetensors"):
+        from safetensors.numpy import load_file
+        return _unflatten(load_file(path))  # _unflatten splits "/" and "."
+    if path.endswith((".h5", ".hdf5")):
+        return _load_h5(path)
+    if path.endswith(".npz"):
+        import numpy as np
+        with np.load(path, allow_pickle=False) as z:
+            return _unflatten({k: z[k] for k in z.files})
+    if os.path.exists(path + ".index"):
+        return _load_tf_checkpoint(path)
+    raise ValueError(f"Cannot determine checkpoint format of {path!r}")
+
+
+def _looks_like_orbax(path: str) -> bool:
+    try:
+        import orbax.checkpoint as ocp
+        with ocp.PyTreeCheckpointer() as ckptr:
+            ckptr.metadata(path)
+        return True
+    except Exception:
+        return False
+
+
+def _unflatten(flat: Mapping[str, object]) -> dict:
+    # Both "/" and "." appear as path separators in the wild: this repo's
+    # own safetensors writers join with "/", Keras h5 uses "/", TF
+    # checkpoints use "/", npz conventions vary.
+    tree: dict = {}
+    for key, val in flat.items():
+        parts = key.replace("/", ".").split(".")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = val
+    return tree
+
+
+def _load_h5(path: str) -> dict:
+    import h5py
+    out: dict = {}
+
+    def visit(name, obj):
+        if isinstance(obj, h5py.Dataset):
+            node = out
+            parts = [p for p in name.split("/") if p]
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            node[parts[-1]] = obj[()]
+
+    with h5py.File(path, "r") as f:
+        f.visititems(visit)
+    return out
+
+
+def _load_tf_checkpoint(prefix: str) -> dict:
+    import tensorflow as tf
+    reader = tf.train.load_checkpoint(prefix)
+    flat = {name: reader.get_tensor(name)
+            for name in reader.get_variable_to_shape_map()}
+    return _unflatten(flat)

@@ -1,0 +1,63 @@
+"""Composable graph pieces.
+
+Reference surface: ``python/sparkdl/graph/pieces.py`` —
+``buildSpImageConverter`` (image-struct fields → float tensor, channel
+reorder + rescale) and ``buildFlattener`` (tensor → per-row flat vector),
+spliced in front of / behind model graphs (SURVEY.md §2.1/§3.3).
+
+TPU-native deltas: struct *decode* happens once at the Arrow boundary
+(``imageIO.imageColumnToNHWC``), so the converter piece here starts from a
+uint8/float NHWC batch — dtype cast, BGR→RGB reorder, and model rescaling are
+the parts that belong inside the XLA program, where they fuse with the model.
+"""
+
+from __future__ import annotations
+
+from .function import GraphFunction
+
+
+def buildSpImageConverter(channelOrder: str = "BGR",
+                          img_dtype: str = "uint8",
+                          scale: float | None = None,
+                          offset: float | None = None) -> GraphFunction:
+    """NHWC image batch (as stored: BGR, uint8) → float32 model-input batch.
+
+    ``channelOrder``: order of the *incoming* batch ("BGR" = at-rest struct
+    order, flipped to RGB here; "RGB" = passthrough). ``scale``/``offset``:
+    optional affine rescale (e.g. scale=1/127.5, offset=-1 for the
+    [-1, 1] preprocessing family).
+
+    feeds: ``image``; fetches: ``converted``.
+    """
+    import jax.numpy as jnp
+
+    flip = channelOrder.upper() == "BGR"
+    del img_dtype  # cast is unconditional; kept for reference-parity arity
+
+    def fn(feeds: dict) -> dict:
+        x = jnp.asarray(feeds["image"])
+        if x.ndim != 4:
+            raise ValueError(f"Expected NHWC batch, got shape {x.shape}")
+        x = x.astype(jnp.float32)
+        if flip and x.shape[-1] >= 3:
+            x = jnp.concatenate([x[..., 2::-1][..., :3], x[..., 3:]], axis=-1)
+        if scale is not None:
+            x = x * scale
+        if offset is not None:
+            x = x + offset
+        return {"converted": x}
+
+    return GraphFunction(fn, ["image"], ["converted"])
+
+
+def buildFlattener(input_name: str = "input",
+                   output_name: str = "flattened") -> GraphFunction:
+    """(N, ...) batch → (N, prod(...)) float32 — the piece the reference
+    appended so model outputs land as per-row vectors in the DataFrame."""
+    import jax.numpy as jnp
+
+    def fn(feeds: dict) -> dict:
+        x = jnp.asarray(feeds[input_name])
+        return {output_name: x.reshape(x.shape[0], -1).astype(jnp.float32)}
+
+    return GraphFunction(fn, [input_name], [output_name])

@@ -1,0 +1,51 @@
+"""makeGraphUDF — register a graph as a named column function.
+
+Reference surface: ``python/sparkdl/graph/tensorframes_udf.py`` —
+``makeGraphUDF(graph, name, fetches)`` registered a TF graph as a Spark SQL
+UDF executed by TensorFrames in the JVM (SURVEY.md §2.1/§3.3). Here the
+registry lives in-process (``sparkdl_tpu.udf``) and the graph executes as a
+jitted XLA program over Arrow batches.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from .builder import IsolatedSession
+from .function import GraphFunction
+from .input import XlaInputGraph
+
+
+def makeGraphUDF(graph, name: str, fetches: Sequence[str] | None = None,
+                 blocked: bool = True, batchSize: int = 64) -> None:
+    """Register ``graph`` under ``name`` in the UDF registry.
+
+    ``graph``: a GraphFunction, XlaInputGraph, IsolatedSession export, a
+    jax-traceable callable, or serialized GraphFunction bytes/path.
+    ``fetches`` picks the output (single fetch — column UDFs are one-column).
+    ``blocked`` is reference-parity arity: execution here is always batched
+    (blocked=False row-at-a-time would be a de-optimization on TPU).
+    """
+    from ..udf import registerUDF
+
+    if isinstance(graph, XlaInputGraph):
+        gfn = graph.translateToGraphFunction()
+    elif isinstance(graph, GraphFunction):
+        gfn = graph
+    elif isinstance(graph, IsolatedSession):
+        raise TypeError("Pass issn.asGraphFunction(inputs, outputs), not the "
+                        "session itself")
+    elif isinstance(graph, (bytes, bytearray)):
+        gfn = GraphFunction.deserialize(bytes(graph))
+    elif isinstance(graph, str):
+        gfn = GraphFunction.load(graph)
+    elif callable(graph):
+        gfn = GraphFunction.fromJax(graph)
+    else:
+        raise TypeError(f"Cannot make a UDF from {type(graph).__name__}")
+
+    del blocked
+    if isinstance(fetches, str):
+        fetches = [fetches]
+    fetch = fetches[0] if fetches else None
+    registerUDF(name, gfn.as_single_output_fn(fetch), batchSize=batchSize)

@@ -1,0 +1,426 @@
+"""Graph toolkit tests (reference test models: graph/test_builder.py,
+test_input.py, test_pieces.py — equivalence-style, SURVEY.md §4)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from sparkdl_tpu.graph import (GraphFunction, IsolatedSession, TFInputGraph,
+                               XlaInputGraph, buildFlattener,
+                               buildSpImageConverter, load_weights,
+                               makeGraphUDF, op_name, tensor_name,
+                               validated_input, validated_output)
+
+
+# ---------------------------------------------------------------- utils ----
+
+def test_name_hygiene():
+    assert op_name("x:0") == "x"
+    assert op_name("x") == "x"
+    assert tensor_name("x") == "x:0"
+    assert tensor_name("x:1") == "x:1"
+    with pytest.raises(ValueError):
+        op_name("bad name!")
+    with pytest.raises(TypeError):
+        op_name(None)
+
+
+def test_validated_feeds_fetches():
+    assert validated_input("a:0", ["a", "b"]) == "a"
+    with pytest.raises(ValueError):
+        validated_input("c", ["a", "b"])
+    assert validated_output("b", ["a", "b"]) == "b"
+    with pytest.raises(ValueError):
+        validated_output("z:0", ["a"])
+
+
+# -------------------------------------------------------- GraphFunction ----
+
+def test_from_jax_and_call():
+    g = GraphFunction.fromJax(lambda x: x * 2.0, ["x"], ["y"])
+    out = g(x=np.ones((2, 3), np.float32))
+    assert np.allclose(out["y"], 2.0)
+    # TF-style ":0" spellings accepted
+    out2 = g({"x:0": np.ones((2, 3), np.float32)})
+    assert np.allclose(out2["y"], 2.0)
+    with pytest.raises(ValueError, match="Missing feeds"):
+        g({})
+    with pytest.raises(ValueError, match="Unknown feeds"):
+        g(x=np.ones(3), z=np.ones(3))
+
+
+def test_multi_output_requires_names():
+    with pytest.raises(ValueError, match="declare output_names"):
+        GraphFunction.fromJax(lambda x: (x, x * 2), ["x"])(x=np.ones(2))
+    g = GraphFunction.fromJax(lambda x: (x + 1, x * 2), ["x"], ["a", "b"])
+    out = g(x=np.ones(2, np.float32))
+    assert np.allclose(out["a"], 2.0) and np.allclose(out["b"], 2.0)
+    g2 = GraphFunction.fromJax(lambda x: {"s": x.sum()}, ["x"], ["s"])
+    assert float(g2(x=np.ones(4, np.float32))["s"]) == 4.0
+
+
+def test_from_list_chains_positionally():
+    a = GraphFunction.fromJax(lambda x: x + 1.0, ["x"], ["u"])
+    b = GraphFunction.fromJax(lambda u: u * 3.0, ["inp"], ["v"])
+    chain = GraphFunction.fromList([a, b])
+    assert chain.input_names == ["x"] and chain.output_names == ["v"]
+    assert np.allclose(chain(x=np.ones(2, np.float32))["v"], 6.0)
+    assert np.allclose(a.then(b)(x=np.ones(2, np.float32))["v"], 6.0)
+    two_out = GraphFunction.fromJax(lambda x: (x, x), ["x"], ["p", "q"])
+    with pytest.raises(ValueError, match="arity"):
+        GraphFunction.fromList([two_out, b])
+
+
+def test_rename():
+    g = GraphFunction.fromJax(lambda x: x * 2.0, ["x"], ["y"])
+    r = g.rename(inputs={"x": "image"}, outputs={"y": "features"})
+    assert r.input_names == ["image"] and r.output_names == ["features"]
+    assert np.allclose(r(image=np.ones(2, np.float32))["features"], 2.0)
+
+
+def test_serialize_roundtrip_symbolic_batch(tmp_path):
+    g = GraphFunction.fromJax(lambda x: jnp.tanh(x @ jnp.ones((3, 2))),
+                              ["x"], ["y"])
+    path = os.path.join(tmp_path, "g.gfn")
+    g.dump(path, {"x": ((None, 3), "float32")})
+    g2 = GraphFunction.load(path)
+    assert g2.input_names == ["x"] and g2.output_names == ["y"]
+    for n in (1, 4, 7):  # symbolic batch dim: any size works
+        x = np.random.RandomState(n).randn(n, 3).astype(np.float32)
+        assert np.allclose(g2(x=x)["y"], g(x=x)["y"], atol=1e-6)
+    with pytest.raises(ValueError, match="serialize needs input_specs"):
+        GraphFunction.fromJax(lambda x: x, ["x"], ["y"]).serialize()
+    with pytest.raises(ValueError, match="Not a serialized"):
+        GraphFunction.deserialize(b"junk")
+
+
+def test_serialize_independent_variable_dims():
+    # leading None dims share the batch symbol; other None dims are each
+    # independent — batch != height must work after a roundtrip
+    g = GraphFunction.fromJax(lambda x: x.sum(axis=(1, 2)), ["x"], ["y"])
+    blob = g.serialize({"x": ((None, None, 3), "float32")})
+    g2 = GraphFunction.deserialize(blob)
+    x = np.ones((2, 7, 3), np.float32)  # batch=2, height=7: distinct
+    assert np.allclose(g2(x=x)["y"], 21.0)
+
+
+def test_jit_and_single_output_adapter():
+    g = GraphFunction.fromJax(lambda x: x - 1.0, ["x"], ["y"])
+    jitted = g.jit()
+    x = np.ones((3,), np.float32)
+    assert np.allclose(jitted(x=x)["y"], 0.0)
+    fn = g.as_single_output_fn()
+    assert np.allclose(fn(x), 0.0)
+    multi = GraphFunction.fromJax(lambda a, b: a + b, ["a", "b"], ["y"])
+    with pytest.raises(ValueError, match="exactly one input"):
+        multi.as_single_output_fn()
+
+
+# ------------------------------------------------------ IsolatedSession ----
+
+def test_isolated_session_build_run_export():
+    with IsolatedSession() as issn:
+        x = issn.placeholder((None, 3), "float32", name="x")
+        w = issn.constant(np.full((3,), 2.0, np.float32), name="w")
+        z = issn.apply(jnp.tanh, x * w + 1.0, name="z")
+        gfn = issn.asGraphFunction([x], [z])
+    v = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+    expect = np.tanh(v * 2.0 + 1.0)
+    # eager run (Session.run analogue)
+    assert np.allclose(issn.run(z, {"x": v}), expect, atol=1e-6)
+    # exported artifact
+    assert np.allclose(gfn(x=v)["z"], expect, atol=1e-6)
+    assert gfn.input_names == ["x"] and gfn.output_names == ["z"]
+
+
+def test_isolated_session_operators():
+    with IsolatedSession() as issn:
+        a = issn.placeholder((None,), name="a")
+        b = issn.placeholder((None,), name="b")
+        exprs = [a + b, a - b, a * b, a / b, -a, 1.0 + a, 2.0 * b,
+                 3.0 - a, 6.0 / b, a[0]]
+        gfn = issn.asGraphFunction([a, b], exprs)
+    av = np.array([2.0, 4.0], np.float32)
+    bv = np.array([1.0, 2.0], np.float32)
+    out = gfn(a=av, b=bv)
+    vals = [out[n] for n in gfn.output_names]
+    for got, want in zip(vals, [av + bv, av - bv, av * bv, av / bv, -av,
+                                1 + av, 2 * bv, 3 - av, 6 / bv, av[0]]):
+        assert np.allclose(got, want)
+
+
+def test_import_graph_function_splices():
+    inner = GraphFunction.fromJax(lambda x: x * 10.0, ["x"], ["y"])
+    with IsolatedSession() as issn:
+        a = issn.placeholder((None,), name="a")
+        mid = issn.apply(lambda t: t + 1.0, a)
+        outs = issn.importGraphFunction(inner, [mid], prefix="sub")
+        gfn = issn.asGraphFunction([a], outs)
+    assert np.allclose(gfn(a=np.ones(2, np.float32))[gfn.output_names[0]],
+                       20.0)
+    with pytest.raises(ValueError, match="expects 1 inputs"):
+        with IsolatedSession() as issn:
+            a = issn.placeholder((None,), name="a")
+            issn.importGraphFunction(inner, [a, a])
+
+
+def test_cross_session_nodes_rejected():
+    with IsolatedSession() as s1:
+        a = s1.placeholder((None,), name="a")
+    with IsolatedSession() as s2:
+        with pytest.raises(ValueError, match="another session"):
+            s2.apply(jnp.tanh, a)
+
+
+def test_non_placeholder_input_rejected():
+    with IsolatedSession() as issn:
+        a = issn.placeholder((None,), name="a")
+        z = issn.apply(jnp.tanh, a)
+        with pytest.raises(ValueError, match="not a placeholder"):
+            issn.asGraphFunction([z], [z])
+
+
+# --------------------------------------------------------------- pieces ----
+
+def test_sp_image_converter_bgr_and_rescale():
+    conv = buildSpImageConverter("BGR", scale=1 / 127.5, offset=-1.0)
+    x = np.random.RandomState(0).randint(0, 256, (2, 5, 5, 3)).astype(np.uint8)
+    out = np.asarray(conv(image=x)["converted"])
+    want = x[..., ::-1].astype(np.float32) / 127.5 - 1.0
+    assert np.allclose(out, want, atol=1e-6)
+    # RGB passthrough, no rescale
+    conv2 = buildSpImageConverter("RGB")
+    assert np.allclose(np.asarray(conv2(image=x)["converted"]),
+                       x.astype(np.float32))
+
+
+def test_flattener_and_composed_pipeline():
+    conv = buildSpImageConverter("BGR")
+    flat = buildFlattener("converted", "flattened")
+    chain = GraphFunction.fromList([conv, flat])
+    x = np.random.RandomState(1).randint(0, 256, (3, 4, 4, 3)).astype(np.uint8)
+    out = np.asarray(chain(image=x)["flattened"])
+    assert out.shape == (3, 48)
+    assert np.allclose(out, x[..., ::-1].reshape(3, -1).astype(np.float32))
+
+
+# -------------------------------------------------------- XlaInputGraph ----
+
+def test_from_graph_and_from_graph_function():
+    ig = XlaInputGraph.fromGraph(lambda x: x * 2.0, ["x"], ["y"])
+    assert np.allclose(
+        ig.translateToGraphFunction()(x=np.ones(2, np.float32))["y"], 2.0)
+    assert TFInputGraph is XlaInputGraph
+    g = GraphFunction.fromJax(lambda x: x, ["x"], ["y"])
+    assert XlaInputGraph.fromGraphFunction(g).asGraphFunction() is g
+
+
+def test_from_serialized(tmp_path):
+    g = GraphFunction.fromJax(lambda x: x + 5.0, ["x"], ["y"])
+    blob = g.serialize({"x": ((None,), "float32")})
+    ig = XlaInputGraph.fromSerialized(blob)
+    assert np.allclose(
+        ig.translateToGraphFunction()(x=np.zeros(3, np.float32))["y"], 5.0)
+    p = os.path.join(tmp_path, "g.gfn")
+    g.dump(p, {"x": ((None,), "float32")})
+    ig2 = XlaInputGraph.fromSerialized(p)
+    assert ig2.output_names == ["y"]
+
+
+def test_from_keras_equivalence():
+    keras = pytest.importorskip("keras")
+    model = keras.Sequential([
+        keras.layers.Input((6,)),
+        keras.layers.Dense(4, activation="tanh"),
+        keras.layers.Dense(2),
+    ])
+    ig = XlaInputGraph.fromKeras(model)
+    x = np.random.RandomState(0).randn(3, 6).astype(np.float32)
+    got = np.asarray(ig.translateToGraphFunction()(input=x)["output"])
+    want = np.asarray(model(x))
+    assert np.allclose(got, want, atol=1e-5)
+
+
+def test_from_flax():
+    import flax.linen as nn
+    import jax
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(2)(x)
+
+    m = Tiny()
+    variables = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 3)))
+    ig = XlaInputGraph.fromFlax(m, variables)
+    x = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+    got = np.asarray(ig.translateToGraphFunction()(input=x)["output"])
+    assert np.allclose(got, np.asarray(m.apply(variables, x)), atol=1e-6)
+
+
+@pytest.fixture(scope="module")
+def tf():
+    return pytest.importorskip("tensorflow")
+
+
+def test_from_saved_model(tf, tmp_path):
+    class M(tf.Module):
+        def __init__(self):
+            super().__init__()
+            self.w = tf.Variable(tf.ones((3, 2)))
+
+        @tf.function(input_signature=[
+            tf.TensorSpec([None, 3], tf.float32, name="x")])
+        def __call__(self, x):
+            return {"y": tf.matmul(x, self.w) + 1.0}
+
+    path = os.path.join(tmp_path, "sm")
+    tf.saved_model.save(M(), path)
+    ig = XlaInputGraph.fromSavedModel(path)
+    assert ig.input_names == ["x"] and ig.output_names == ["y"]
+    x = np.ones((2, 3), np.float32)
+    assert np.allclose(ig.translateToGraphFunction()(x=x)["y"], 4.0)
+    with pytest.raises(ValueError, match="no signature"):
+        XlaInputGraph.fromSavedModel(path, signature="nope")
+    ig2 = XlaInputGraph.fromSavedModelWithSignature(path, "serving_default")
+    assert ig2.output_names == ["y"]
+    # feed/fetch names bind BY NAME against signature keys, never position
+    with pytest.raises(ValueError, match="not a signature input"):
+        XlaInputGraph.fromSavedModel(path, feed_names=["wrong"])
+    with pytest.raises(ValueError, match="not a signature output"):
+        XlaInputGraph.fromSavedModel(path, fetch_names=["nope"])
+
+
+def test_from_saved_model_fetch_selection_by_name(tf, tmp_path):
+    class M2(tf.Module):
+        @tf.function(input_signature=[
+            tf.TensorSpec([None, 2], tf.float32, name="x")])
+        def __call__(self, x):
+            # alphabetical order is (logits, probs); select 'probs' by name
+            return {"logits": x * 10.0, "probs": x * 0.1}
+
+    path = os.path.join(tmp_path, "sm2")
+    tf.saved_model.save(M2(), path)
+    ig = XlaInputGraph.fromSavedModel(path, fetch_names=["probs"])
+    x = np.ones((2, 2), np.float32)
+    out = ig.translateToGraphFunction()(x=x)
+    assert list(out) == ["probs"]
+    assert np.allclose(out["probs"], 0.1)
+
+
+def test_from_graph_def(tf):
+    with tf.Graph().as_default() as g:
+        xin = tf.compat.v1.placeholder(tf.float32, [None, 3], name="xin")
+        tf.identity(xin * 2.0 + 0.5, name="yout")
+    ig = XlaInputGraph.fromGraphDef(g.as_graph_def(), ["xin"], ["yout"])
+    x = np.ones((2, 3), np.float32)
+    out = ig.translateToGraphFunction()(xin=x)["yout"]
+    assert np.allclose(out, 2.5)
+    # serialized proto bytes accepted too
+    ig2 = XlaInputGraph.fromGraphDef(
+        g.as_graph_def().SerializeToString(), ["xin:0"], ["yout:0"])
+    assert np.allclose(
+        ig2.translateToGraphFunction()(xin=x)["yout"], 2.5)
+
+
+# ------------------------------------------------------- weight loading ----
+
+def test_load_weights_npz(tmp_path):
+    p = os.path.join(tmp_path, "w.npz")
+    np.savez(p, **{"layer1.kernel": np.ones((2, 2)),
+                   "layer1.bias": np.zeros(2)})
+    tree = load_weights(p)
+    assert set(tree["layer1"]) == {"kernel", "bias"}
+
+
+def test_load_weights_safetensors(tmp_path):
+    st = pytest.importorskip("safetensors.numpy")
+    p = os.path.join(tmp_path, "w.safetensors")
+    # both separators appear in the wild ("/" is what this repo's own
+    # safetensors writers emit)
+    st.save_file({"a.b": np.arange(4, dtype=np.float32),
+                  "Dense_0/kernel": np.ones((2, 2), np.float32)}, p)
+    tree = load_weights(p)
+    assert np.allclose(tree["a"]["b"], np.arange(4))
+    assert tree["Dense_0"]["kernel"].shape == (2, 2)
+
+
+def test_load_weights_h5(tmp_path):
+    h5py = pytest.importorskip("h5py")
+    p = os.path.join(tmp_path, "w.h5")
+    with h5py.File(p, "w") as f:
+        f.create_dataset("dense/kernel", data=np.ones((3, 3)))
+    tree = load_weights(p)
+    assert tree["dense"]["kernel"].shape == (3, 3)
+
+
+def test_load_weights_tf_checkpoint(tf, tmp_path):
+    v = tf.Variable(np.full((2,), 7.0, np.float32), name="my/var")
+    ckpt = tf.train.Checkpoint(v=v)
+    prefix = ckpt.write(os.path.join(tmp_path, "ck"))
+    tree = load_weights(prefix)
+    flat = []
+
+    def walk(node):
+        for val in node.values():
+            (walk if isinstance(val, dict) else
+             lambda x: flat.append(np.asarray(x)))(val)
+    walk(tree)
+    assert any(a.shape == (2,) and np.allclose(a, 7.0) for a in flat)
+
+
+def test_load_weights_unknown(tmp_path):
+    with pytest.raises(ValueError, match="Cannot determine"):
+        load_weights(os.path.join(tmp_path, "nothing.xyz"))
+
+
+def test_from_checkpoint_binds_model_fn(tmp_path):
+    p = os.path.join(tmp_path, "w.npz")
+    np.savez(p, **{"w": np.full((3, 2), 2.0, np.float32)})
+    ig = XlaInputGraph.fromCheckpoint(
+        p, lambda params, batch: batch @ params["w"])
+    x = np.ones((2, 3), np.float32)
+    assert np.allclose(
+        ig.translateToGraphFunction()(input=x)["output"], 6.0)
+
+
+# ---------------------------------------------------------- makeGraphUDF ----
+
+def test_make_graph_udf_end_to_end():
+    from sparkdl_tpu import DataFrame
+    from sparkdl_tpu.udf import applyUDF, unregisterUDF
+
+    gfn = GraphFunction.fromJax(lambda x: x * 3.0, ["x"], ["y"])
+    makeGraphUDF(gfn, "triple")
+    try:
+        df = DataFrame.fromPandas(
+            __import__("pandas").DataFrame(
+                {"v": [np.ones(2, np.float32) * i for i in range(4)]}))
+        out = applyUDF(df, "triple", "v", "tripled").toPandas()
+        assert np.allclose(np.stack(out["tripled"].to_numpy()),
+                           np.stack([np.ones(2) * 3 * i for i in range(4)]))
+    finally:
+        unregisterUDF("triple")
+
+
+def test_make_graph_udf_kinds():
+    from sparkdl_tpu.udf import listUDFs, unregisterUDF
+    try:
+        makeGraphUDF(lambda x: x + 1, "callable_udf")
+        blob = GraphFunction.fromJax(lambda x: x, ["x"], ["y"]).serialize(
+            {"x": ((None,), "float32")})
+        makeGraphUDF(blob, "blob_udf")
+        assert {"callable_udf", "blob_udf"} <= set(listUDFs())
+        # a bare-string fetches must mean the fetch name, not its first char
+        g3 = GraphFunction.fromJax(lambda x: {"probs": x}, ["x"], ["probs"])
+        makeGraphUDF(g3, "str_fetch_udf", fetches="probs")
+        with pytest.raises(TypeError, match="asGraphFunction"):
+            makeGraphUDF(IsolatedSession(), "bad")
+        with pytest.raises(TypeError, match="Cannot make a UDF"):
+            makeGraphUDF(123, "bad")
+    finally:
+        unregisterUDF("callable_udf")
+        unregisterUDF("blob_udf")
