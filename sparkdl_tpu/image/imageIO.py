@@ -179,6 +179,13 @@ def structsToNHWC(structs: Sequence[dict], height: int | None = None,
     w = width if width is not None else first["width"]
     c = first["nChannels"]
     flip = channelOrder.upper() == "RGB" and c >= 3
+    if all(s["nChannels"] == c for s in structs):
+        packed = _native_pack_or_none(
+            [s["data"] for s in structs], [s["height"] for s in structs],
+            [s["width"] for s in structs], [s["mode"] for s in structs],
+            c, h, w, flip, dtype)
+        if packed is not None:
+            return packed
     out = np.empty((len(structs), h, w, c), dtype=dtype)
     for i, s in enumerate(structs):
         if s["nChannels"] != c:
@@ -216,6 +223,11 @@ def imageColumnToNHWC(column: pa.Array, height: int | None = None,
         raise ValueError(f"Mixed channel counts in image column: "
                          f"{sorted(set(chans.tolist()))}")
     flip = channelOrder.upper() == "RGB" and c >= 3
+    packed = _native_pack_or_none(
+        [data[i].as_buffer() for i in range(n)], heights, widths, modes,
+        c, h, w, flip, dtype)
+    if packed is not None:
+        return packed
     out = np.empty((n, h, w, c), dtype=dtype)
     for i in range(n):
         src_dtype = ocvTypeByMode(int(modes[i])).dtype
@@ -229,6 +241,27 @@ def imageColumnToNHWC(column: pa.Array, height: int | None = None,
             img = imageStructToArray(resizeImage(struct, h, w))
         out[i] = img[:, :, ::-1] if flip else img
     return out
+
+
+def _native_pack_or_none(buffers, heights, widths, modes, c, h, w, flip,
+                         dtype):
+    """Shared hot-path gate: all-uint8 rows + float32 out → the native
+    packer (C++: threaded resize + channel flip + u8→f32 in one pass; the
+    TensorFrames-JNI-equivalent role, SURVEY.md §2.3). None ⇒ caller takes
+    the pure-python path. NB: the fallback resizes through uint8 (PIL), so
+    resized values can differ from the native float path by <1 level —
+    native.py logs once when the library is unavailable.
+    """
+    if (np.dtype(dtype) != np.float32
+            or os.environ.get("SPARKDL_TPU_NATIVE", "1") == "0"
+            or not all(ocvTypeByMode(int(m)).dtype == "uint8"
+                       for m in modes)):
+        return None
+    from .. import native
+    if not native.available():
+        return None
+    return native.pack_images(buffers, heights, widths, c, h, w,
+                              flip_bgr=flip)
 
 
 def nhwcToStructs(batch: np.ndarray, origins: Sequence[str] | None = None,

@@ -1,0 +1,186 @@
+"""ctypes binding for the native batch packer (native/packing.cpp).
+
+The reference's partition-batch data path ran through TensorFrames' JNI
+bridge into TF C++ (SURVEY.md §2.3); here the in-tree native component is
+``libsparkdl_native.so``: multithreaded resize + channel-reorder + uint8→f32
+NHWC packing, producing the host batch that ``jax.device_put`` ships to HBM.
+
+``pack_images``/``pack_batch`` transparently fall back to numpy/PIL when the
+shared library hasn't been built (``ensure_built`` compiles it with g++ on
+first use; pybind11 is unavailable in this image, hence the C ABI).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Sequence
+
+import numpy as np
+
+_log = logging.getLogger(__name__)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libsparkdl_native.so")
+
+_lock = threading.Lock()
+_lib = None
+_build_failed = False
+
+
+def ensure_built() -> bool:
+    """Compile the .so if missing/stale. Returns availability."""
+    global _build_failed
+    src = os.path.join(_NATIVE_DIR, "packing.cpp")
+    if not os.path.exists(src):
+        return os.path.exists(_SO_PATH)
+    if (os.path.exists(_SO_PATH)
+            and os.path.getmtime(_SO_PATH) >= os.path.getmtime(src)):
+        return True
+    if _build_failed:
+        return False
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, OSError) as e:
+        _build_failed = True
+        # Loud once: the PIL fallback resizes through uint8, so resized
+        # batches differ (<1 level per value) from native-built hosts.
+        _log.warning(
+            "sparkdl_tpu native packer build failed (%s); using the "
+            "pure-python fallback — resized image batches will differ "
+            "slightly from native-enabled hosts", e)
+        return False
+
+
+def _load():
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not ensure_built():
+            return None
+        lib = ctypes.CDLL(_SO_PATH)
+        lib.sdl_abi_version.restype = ctypes.c_int
+        if lib.sdl_abi_version() != 1:
+            return None
+        lib.sdl_pack_images.restype = ctypes.c_int
+        lib.sdl_pack_images.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p),           # srcs
+            ctypes.POINTER(ctypes.c_int32),            # heights
+            ctypes.POINTER(ctypes.c_int32),            # widths
+            ctypes.c_int32, ctypes.c_int32,            # n, c
+            ctypes.POINTER(ctypes.c_float),            # out
+            ctypes.c_int32, ctypes.c_int32,            # out_h, out_w
+            ctypes.c_int32,                            # flip_bgr
+            ctypes.c_float, ctypes.c_float,            # scale, offset
+            ctypes.c_int32,                            # n_threads
+        ]
+        lib.sdl_pack_batch.restype = ctypes.c_int
+        lib.sdl_pack_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_float, ctypes.c_float, ctypes.c_int32,
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def pack_images(buffers: Sequence, heights: Sequence[int],
+                widths: Sequence[int], channels: int, out_h: int, out_w: int,
+                flip_bgr: bool = True, scale: float = 1.0,
+                offset: float = 0.0, n_threads: int = 0) -> np.ndarray:
+    """Variable-size uint8 HWC image buffers → (N, out_h, out_w, C) float32.
+
+    ``buffers``: per-image bytes-like objects (Arrow binary buffers, bytes,
+    or uint8 arrays) each holding heights[i]*widths[i]*channels bytes.
+    """
+    n = len(buffers)
+    out = np.empty((n, out_h, out_w, channels), dtype=np.float32)
+    if n == 0:
+        return out
+    lib = _load()
+    if lib is None:
+        return _pack_images_numpy(buffers, heights, widths, channels, out,
+                                  flip_bgr, scale, offset)
+    for b in buffers:
+        if isinstance(b, np.ndarray) and b.dtype != np.uint8:
+            raise TypeError(
+                f"pack_images takes raw uint8 buffers, got ndarray dtype "
+                f"{b.dtype} (value-casting would silently truncate)")
+    arrays = [np.frombuffer(b, dtype=np.uint8) if not isinstance(b, np.ndarray)
+              else np.ascontiguousarray(b).reshape(-1)
+              for b in buffers]
+    for i, a in enumerate(arrays):
+        if a.size != heights[i] * widths[i] * channels:
+            raise ValueError(
+                f"Image {i}: buffer has {a.size} bytes, expected "
+                f"{heights[i]}x{widths[i]}x{channels}")
+    ptrs = (ctypes.c_void_p * n)(
+        *[a.ctypes.data_as(ctypes.c_void_p) for a in arrays])
+    hs = np.asarray(heights, dtype=np.int32)
+    ws = np.asarray(widths, dtype=np.int32)
+    rc = lib.sdl_pack_images(
+        ptrs, hs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ws.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        n, channels, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out_h, out_w, int(flip_bgr), float(scale), float(offset), n_threads)
+    if rc != 0:
+        raise ValueError(f"sdl_pack_images failed with code {rc}")
+    return out
+
+
+def pack_batch(batch: np.ndarray, out_h: int | None = None,
+               out_w: int | None = None, flip_bgr: bool = False,
+               scale: float = 1.0, offset: float = 0.0,
+               n_threads: int = 0) -> np.ndarray:
+    """(N, H, W, C) uint8 → (N, out_h, out_w, C) float32 in one native call."""
+    batch = np.ascontiguousarray(batch, dtype=np.uint8)
+    n, h, w, c = batch.shape
+    oh, ow = out_h or h, out_w or w
+    lib = _load()
+    if lib is None:
+        bufs = [batch[i] for i in range(n)]
+        out = np.empty((n, oh, ow, c), dtype=np.float32)
+        return _pack_images_numpy(bufs, [h] * n, [w] * n, c, out, flip_bgr,
+                                  scale, offset)
+    out = np.empty((n, oh, ow, c), dtype=np.float32)
+    rc = lib.sdl_pack_batch(
+        batch.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n, h, w, c,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), oh, ow,
+        int(flip_bgr), float(scale), float(offset), n_threads)
+    if rc != 0:
+        raise ValueError(f"sdl_pack_batch failed with code {rc}")
+    return out
+
+
+def _pack_images_numpy(buffers, heights, widths, channels, out, flip_bgr,
+                       scale, offset) -> np.ndarray:
+    """Pure-python fallback; PIL handles the resizes."""
+    from PIL import Image
+    n, oh, ow, c = out.shape
+    for i in range(n):
+        arr = np.frombuffer(buffers[i], dtype=np.uint8).reshape(
+            heights[i], widths[i], channels)
+        if flip_bgr and c >= 3:
+            arr = np.concatenate([arr[..., 2::-1][..., :3], arr[..., 3:]],
+                                 axis=-1)
+        if (heights[i], widths[i]) != (oh, ow):
+            img = Image.fromarray(arr.squeeze() if c == 1 else arr)
+            arr = np.asarray(img.resize((ow, oh), Image.BILINEAR),
+                             dtype=np.uint8)
+            if arr.ndim == 2:
+                arr = arr[:, :, None]
+        out[i] = arr.astype(np.float32) * scale + offset
+    return out

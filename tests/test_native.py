@@ -1,0 +1,111 @@
+"""Native batch-packer tests (C++ lib vs numpy/jax references)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from sparkdl_tpu import native
+
+
+@pytest.fixture(scope="module", autouse=True)
+def built():
+    if not native.ensure_built():
+        pytest.skip("native toolchain unavailable")
+
+
+def test_abi_available():
+    assert native.available()
+
+
+def test_pack_batch_exact_no_resize():
+    rng = np.random.RandomState(0)
+    b = rng.randint(0, 256, (4, 5, 6, 3)).astype(np.uint8)
+    out = native.pack_batch(b, flip_bgr=True, scale=1 / 127.5, offset=-1.0)
+    assert out.dtype == np.float32
+    want = b[..., ::-1].astype(np.float32) / 127.5 - 1.0
+    assert np.allclose(out, want, atol=1e-6)
+
+
+def test_pack_batch_matches_jax_resize():
+    rng = np.random.RandomState(1)
+    for (h, w), (oh, ow) in [((10, 12), (8, 8)), ((7, 5), (16, 16)),
+                             ((20, 20), (8, 14))]:
+        src = rng.randint(0, 256, (2, h, w, 3)).astype(np.uint8)
+        nat = native.pack_batch(src, oh, ow)
+        ref = np.asarray(jax.image.resize(
+            src.astype(np.float32), (2, oh, ow, 3), method="bilinear"))
+        assert np.abs(nat - ref).max() < 1e-3, ((h, w), (oh, ow))
+
+
+def test_pack_images_variable_sizes():
+    rng = np.random.RandomState(2)
+    hs, ws = [9, 17, 8], [11, 6, 8]
+    bufs = [rng.randint(0, 256, (h, w, 3)).astype(np.uint8).tobytes()
+            for h, w in zip(hs, ws)]
+    out = native.pack_images(bufs, hs, ws, 3, 8, 8, flip_bgr=True)
+    assert out.shape == (3, 8, 8, 3)
+    for i, (h, w) in enumerate(zip(hs, ws)):
+        src = np.frombuffer(bufs[i], np.uint8).reshape(h, w, 3)
+        ref = np.asarray(jax.image.resize(
+            src[..., ::-1].astype(np.float32), (8, 8, 3), method="bilinear"))
+        assert np.abs(out[i] - ref).max() < 1e-3
+
+
+def test_pack_images_bgra_alpha_preserved():
+    rng = np.random.RandomState(3)
+    b = rng.randint(0, 256, (2, 4, 4, 4)).astype(np.uint8)
+    out = native.pack_batch(b, flip_bgr=True)
+    assert np.allclose(out[..., 3], b[..., 3])
+    assert np.allclose(out[..., 0], b[..., 2])
+    assert np.allclose(out[..., 2], b[..., 0])
+
+
+def test_pack_images_grayscale():
+    rng = np.random.RandomState(4)
+    b = rng.randint(0, 256, (3, 6, 6, 1)).astype(np.uint8)
+    out = native.pack_batch(b, flip_bgr=True)  # flip is a no-op for c=1
+    assert np.allclose(out, b.astype(np.float32))
+
+
+def test_bad_buffer_size_raises():
+    with pytest.raises(ValueError, match="expected"):
+        native.pack_images([b"abc"], [4], [4], 3, 4, 4)
+
+
+def test_empty_batch():
+    out = native.pack_images([], [], [], 3, 4, 4)
+    assert out.shape == (0, 4, 4, 3)
+
+
+def test_numpy_fallback_agrees_uniform():
+    rng = np.random.RandomState(5)
+    b = rng.randint(0, 256, (3, 5, 5, 3)).astype(np.uint8)
+    nat = native.pack_batch(b, flip_bgr=True, scale=2.0, offset=1.0)
+    ref = np.empty_like(nat)
+    native._pack_images_numpy([b[i] for i in range(3)], [5] * 3, [5] * 3, 3,
+                              ref, True, 2.0, 1.0)
+    assert np.allclose(nat, ref, atol=1e-5)
+
+
+def test_image_column_uses_native_path(monkeypatch):
+    """imageColumnToNHWC's output must agree with the pure-python path."""
+    import pyarrow as pa
+
+    from sparkdl_tpu.image import imageIO
+
+    rng = np.random.RandomState(6)
+    structs = [imageIO.imageArrayToStruct(
+        rng.randint(0, 256, (7, 7, 3)).astype(np.uint8)) for _ in range(4)]
+    col = pa.array(structs, type=imageIO.imageSchema)
+    monkeypatch.setenv("SPARKDL_TPU_NATIVE", "1")
+    fast = imageIO.imageColumnToNHWC(col)
+    monkeypatch.setenv("SPARKDL_TPU_NATIVE", "0")
+    slow = imageIO.imageColumnToNHWC(col)
+    assert np.allclose(fast, slow, atol=1e-5)
+
+
+def test_pack_images_rejects_nonuint8_arrays():
+    with pytest.raises(TypeError, match="uint8"):
+        native.pack_images([np.ones((4, 4, 3), np.float32)], [4], [4],
+                           3, 4, 4)

@@ -34,13 +34,16 @@ def test_xla_image_transformer_equivalence():
                                 inputSize=(16, 16), batchSize=4)
     out = t.transform(df)
     got = np.asarray([r.feat for r in out.collect()], dtype=np.float32)
-    # direct path: same resize, same fn
-    nhwc = np.stack([
-        imageIO.imageStructToArray(imageIO.resizeImage(
-            imageIO.imageArrayToStruct(im), 16, 16))[:, :, ::-1]
-        for im in imgs]).astype(np.float32)
+    # direct path: same resize convention (antialiased bilinear — native
+    # packer and jax.image.resize agree in float; the PIL fallback rounds
+    # resized pixels to uint8, hence the wider tolerance without native)
+    from sparkdl_tpu import native
+    nhwc = np.stack([np.asarray(jax.image.resize(
+        im[:, :, ::-1].astype(np.float32), (16, 16, 3), method="bilinear"))
+        for im in imgs])
     want = np.asarray(fn(jnp.asarray(nhwc)))
-    np.testing.assert_allclose(got, want, rtol=1e-5)
+    atol = 1e-3 if native.available() else 0.75
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=atol)
 
 
 def test_xla_image_transformer_alias_and_image_output():
