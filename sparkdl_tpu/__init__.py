@@ -23,6 +23,7 @@ from .estimators import (KerasImageFileEstimator, LogisticRegression,
 from .graph import (GraphFunction, IsolatedSession, TFInputGraph,
                     XlaInputGraph, buildFlattener, buildSpImageConverter,
                     makeGraphUDF)
+from .ops import flash_attention
 from .image.imageIO import imageSchema, readImages, readImagesWithCustomFn
 from .transformers import (DeepImageFeaturizer, DeepImagePredictor,
                            KerasImageFileTransformer, KerasTransformer,
@@ -51,6 +52,7 @@ __all__ = [
     "listUDFs",
     "GraphFunction", "IsolatedSession", "XlaInputGraph", "TFInputGraph",
     "buildSpImageConverter", "buildFlattener", "makeGraphUDF",
+    "flash_attention",
     "XlaRunner", "RunnerContext", "TrainState", "CheckpointManager",
     "make_train_step", "make_shard_map_step",
     "__version__",

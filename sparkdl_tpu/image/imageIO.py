@@ -54,6 +54,15 @@ imageSchema = pa.struct([
 ])
 
 
+def _swapRB(arr: np.ndarray) -> np.ndarray:
+    """Swap channels 0<->2 (BGR(A)<->RGB(A)), preserving alpha — the one
+    channel-reorder convention used on every path (incl. the native packer),
+    so results don't depend on which path ran."""
+    if arr.shape[-1] < 3:
+        return arr
+    return np.concatenate([arr[..., 2::-1], arr[..., 3:]], axis=-1)
+
+
 def ocvTypeByMode(mode: int) -> _OcvType:
     try:
         return _OCV_BY_ORD[mode]
@@ -108,7 +117,7 @@ def decodeImage(data: bytes, origin: str = "") -> dict | None:
     except Exception:
         return None
     if arr.ndim == 3 and arr.shape[2] >= 3:
-        arr = np.ascontiguousarray(arr[:, :, ::-1])  # RGB(A) → BGR(A)
+        arr = np.ascontiguousarray(_swapRB(arr))  # RGB(A) → BGR(A)
     return imageArrayToStruct(arr, origin=origin)
 
 
@@ -128,7 +137,7 @@ def encodePng(struct: dict) -> bytes:
     if arr.dtype != np.uint8:
         raise ValueError("encodePng requires uint8 image structs")
     if arr.shape[2] >= 3:
-        arr = arr[:, :, ::-1]  # stored BGR(A) → RGB(A) for PIL
+        arr = _swapRB(arr)  # stored BGR(A) → RGB(A) for PIL
     buf = io.BytesIO()
     Image.fromarray(arr.squeeze() if arr.shape[2] == 1 else arr).save(
         buf, format="PNG")
@@ -181,9 +190,9 @@ def structsToNHWC(structs: Sequence[dict], height: int | None = None,
     flip = channelOrder.upper() == "RGB" and c >= 3
     if all(s["nChannels"] == c for s in structs):
         packed = _native_pack_or_none(
-            [s["data"] for s in structs], [s["height"] for s in structs],
-            [s["width"] for s in structs], [s["mode"] for s in structs],
-            c, h, w, flip, dtype)
+            lambda: [s["data"] for s in structs],
+            [s["height"] for s in structs], [s["width"] for s in structs],
+            [s["mode"] for s in structs], c, h, w, flip, dtype)
         if packed is not None:
             return packed
     out = np.empty((len(structs), h, w, c), dtype=dtype)
@@ -193,7 +202,7 @@ def structsToNHWC(structs: Sequence[dict], height: int | None = None,
         if s["height"] != h or s["width"] != w:
             s = resizeImage(s, h, w)
         arr = imageStructToArray(s)
-        out[i] = arr[:, :, ::-1] if flip else arr
+        out[i] = _swapRB(arr) if flip else arr
     return out
 
 
@@ -224,8 +233,8 @@ def imageColumnToNHWC(column: pa.Array, height: int | None = None,
                          f"{sorted(set(chans.tolist()))}")
     flip = channelOrder.upper() == "RGB" and c >= 3
     packed = _native_pack_or_none(
-        [data[i].as_buffer() for i in range(n)], heights, widths, modes,
-        c, h, w, flip, dtype)
+        lambda: [data[i].as_buffer() for i in range(n)], heights, widths,
+        modes, c, h, w, flip, dtype)
     if packed is not None:
         return packed
     out = np.empty((n, h, w, c), dtype=dtype)
@@ -239,18 +248,20 @@ def imageColumnToNHWC(column: pa.Array, height: int | None = None,
                       "nChannels": c, "mode": int(modes[i]),
                       "data": view.tobytes()}
             img = imageStructToArray(resizeImage(struct, h, w))
-        out[i] = img[:, :, ::-1] if flip else img
+        out[i] = _swapRB(img) if flip else img
     return out
 
 
-def _native_pack_or_none(buffers, heights, widths, modes, c, h, w, flip,
+def _native_pack_or_none(buffers_fn, heights, widths, modes, c, h, w, flip,
                          dtype):
     """Shared hot-path gate: all-uint8 rows + float32 out → the native
     packer (C++: threaded resize + channel flip + u8→f32 in one pass; the
     TensorFrames-JNI-equivalent role, SURVEY.md §2.3). None ⇒ caller takes
-    the pure-python path. NB: the fallback resizes through uint8 (PIL), so
-    resized values can differ from the native float path by <1 level —
-    native.py logs once when the library is unavailable.
+    the pure-python path. ``buffers_fn`` defers per-row buffer
+    materialization until every cheap gate has passed. NB: the fallback
+    resizes through uint8 (PIL), so resized values can differ from the
+    native float path by <1 level — native.py logs once when the library is
+    unavailable.
     """
     if (np.dtype(dtype) != np.float32
             or os.environ.get("SPARKDL_TPU_NATIVE", "1") == "0"
@@ -260,7 +271,7 @@ def _native_pack_or_none(buffers, heights, widths, modes, c, h, w, flip,
     from .. import native
     if not native.available():
         return None
-    return native.pack_images(buffers, heights, widths, c, h, w,
+    return native.pack_images(buffers_fn(), heights, widths, c, h, w,
                               flip_bgr=flip)
 
 
@@ -271,7 +282,7 @@ def nhwcToStructs(batch: np.ndarray, origins: Sequence[str] | None = None,
     origins = origins or [""] * len(batch)
     flip = channelOrder.upper() == "RGB" and batch.shape[-1] >= 3
     return [imageArrayToStruct(
-        np.ascontiguousarray(np.asarray(img)[:, :, ::-1]) if flip
+        np.ascontiguousarray(_swapRB(np.asarray(img))) if flip
         else np.asarray(img), origin=o)
         for img, o in zip(batch, origins)]
 

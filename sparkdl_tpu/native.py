@@ -110,15 +110,15 @@ def pack_images(buffers: Sequence, heights: Sequence[int],
     out = np.empty((n, out_h, out_w, channels), dtype=np.float32)
     if n == 0:
         return out
-    lib = _load()
-    if lib is None:
-        return _pack_images_numpy(buffers, heights, widths, channels, out,
-                                  flip_bgr, scale, offset)
     for b in buffers:
         if isinstance(b, np.ndarray) and b.dtype != np.uint8:
             raise TypeError(
                 f"pack_images takes raw uint8 buffers, got ndarray dtype "
                 f"{b.dtype} (value-casting would silently truncate)")
+    lib = _load()
+    if lib is None:
+        return _pack_images_numpy(buffers, heights, widths, channels, out,
+                                  flip_bgr, scale, offset)
     arrays = [np.frombuffer(b, dtype=np.uint8) if not isinstance(b, np.ndarray)
               else np.ascontiguousarray(b).reshape(-1)
               for b in buffers]

@@ -1,0 +1,229 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The per-device attention hot op (layout ``[B, H, S, D]``, the convention of
+``parallel.ring_attention``). The reference framework had no attention at all
+(2017-era image models — SURVEY.md §2.4); this kernel exists for the
+transformer families (BERT/Llama) and composes with the shard-level
+sequence parallelism: ring attention moves KV blocks across chips over ICI,
+and each hop's local compute can run through this kernel.
+
+Design (the standard streaming-softmax factorization, written for the MXU):
+- grid = (batch·heads, Q tiles, KV tiles); pallas pipelines each (BK, D)
+  KV tile from HBM through the innermost grid dimension while the running
+  row max ``m``, normalizer ``l``, and unnormalized f32 accumulator persist
+  in VMEM scratch across KV steps.
+- S·S attention scores never materialize and no full K/V is ever VMEM
+  resident — VMEM holds one Q, K, V tile + one (BQ, BK) score tile, so
+  sequence length is bounded by HBM, not VMEM.
+- causal masking prunes whole KV tiles: the fori_loop upper bound for query
+  tile ``qi`` covers only tiles at-or-below the diagonal.
+- backward: custom_vjp with blockwise recompute (lax.scan over KV tiles in
+  plain jax) from the saved (o, logsumexp) — activations are O(S·D), the
+  flash-attention memory contract, and XLA keeps the per-tile recompute on
+  the MXU.
+
+``interpret=True`` (or platform != tpu) runs the same kernel through the
+Pallas interpreter — how CPU tests validate kernel semantics.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+_LANES = 128  # per-row stats live broadcast across one lane tile
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, causal: bool, sm_scale: float, seq_len: int):
+    """Grid = (B·H, Q tiles, KV tiles); KV tiles stream through VMEM via the
+    innermost grid dimension (pallas pipelines the HBM loads), while the
+    (BQ, D) accumulator and per-row (m, l) stats persist in VMEM scratch
+    across KV steps. VMEM holds one Q, one K, one V tile + scratch — never
+    the full sequence."""
+    block_q, block_k = q_ref.shape[1], k_ref.shape[1]
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    n_kv = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # Causal: tiles strictly above the diagonal contribute nothing.
+    live = (True if not causal
+            else ki * block_k <= qi * block_q + block_q - 1)
+
+    @pl.when(live)
+    def _update():
+        q = q_ref[0].astype(jnp.float32) * sm_scale        # (BQ, D)
+        k = k_ref[0].astype(jnp.float32)                   # (BK, D)
+        v = v_ref[0].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        col_ids = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = col_ids < seq_len
+        if causal:
+            row_ids = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask = mask & (col_ids <= row_ids)
+        s = jnp.where(mask, s, NEG_INF)
+        m_prev = m_ref[:, 0]
+        l_prev = l_ref[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+
+    @pl.when(ki == n_kv - 1)
+    def _finalize():
+        m = m_ref[:, 0]
+        l = l_ref[:, 0]
+        safe_l = jnp.where(l > 0, l, 1.0)  # fully-masked rows (seq padding)
+        o_ref[0] = (acc_ref[:] / safe_l[:, None]).astype(o_ref.dtype)
+        # lse block spans the whole row (TPU block-shape rules); this
+        # program owns [qi*BQ, qi*BQ+BQ) and the block revisits across qi.
+        lse_ref[0, 0, pl.ds(qi * block_q, block_q)] = m + jnp.log(safe_l)
+
+
+def _fwd(q, k, v, causal: bool, block_q: int, block_k: int,
+         interpret: bool):
+    b, h, s, d = q.shape
+    bq = min(block_q, s)
+    bk = min(block_k, s)
+    # In-kernel pl.ds must never cross the buffer end: pad S up to a common
+    # multiple of both tile sizes; masking uses the true length and padded
+    # rows are sliced off after.
+    unit = math.lcm(bq, bk)
+    s_pad = pl.cdiv(s, unit) * unit
+    sm_scale = 1.0 / math.sqrt(d)
+    q3 = q.reshape(b * h, s, d)
+    k3 = k.reshape(b * h, s, d)
+    v3 = v.reshape(b * h, s, d)
+    if s_pad != s:
+        padding = ((0, 0), (0, s_pad - s), (0, 0))
+        q3 = jnp.pad(q3, padding)
+        k3 = jnp.pad(k3, padding)
+        v3 = jnp.pad(v3, padding)
+    from jax.experimental.pallas import tpu as pltpu
+
+    grid = (b * h, s_pad // bq, s_pad // bk)
+    o3, lse3 = pl.pallas_call(
+        functools.partial(_fwd_kernel, causal=causal,
+                          sm_scale=sm_scale, seq_len=s),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, 1, s_pad), lambda bh, i, j: (bh, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s_pad, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, 1, s_pad), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),        # acc
+            pltpu.VMEM((bq, _LANES), jnp.float32),   # running max m
+            pltpu.VMEM((bq, _LANES), jnp.float32),   # normalizer l
+        ],
+        interpret=interpret,
+    )(q3, k3, v3)
+    return (o3[:, :s].reshape(b, h, s, d),
+            lse3[:, 0, :s].reshape(b, h, s))
+
+
+def _bwd_one_head(q, k, v, o, lse, do, causal: bool, block_k: int,
+                  sm_scale: float):
+    """Blockwise backward for one (S, D) head, plain jax (runs under vmap).
+
+    Recomputes P tile-by-tile from the saved logsumexp; O(S·D) residents.
+    """
+    s_len, d = q.shape
+    bk = min(block_k, s_len)
+    n_blocks = s_len // bk if s_len % bk == 0 else s_len // bk + 1
+    pad = n_blocks * bk - s_len
+    if pad:
+        k = jnp.pad(k, ((0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, pad), (0, 0)))
+    kb = k.reshape(n_blocks, bk, d)
+    vb = v.reshape(n_blocks, bk, d)
+
+    qf = q.astype(jnp.float32) * sm_scale
+    dof = do.astype(jnp.float32)
+    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)   # (S,)
+    row_ids = jnp.arange(s_len)
+
+    def per_block(dq_acc, j):
+        kj = kb[j].astype(jnp.float32)
+        vj = vb[j].astype(jnp.float32)
+        s_tile = qf @ kj.T                                   # (S, BK)
+        col_ids = j * bk + jnp.arange(bk)
+        mask = col_ids[None, :] < s_len
+        if causal:
+            mask = mask & (col_ids[None, :] <= row_ids[:, None])
+        p = jnp.where(mask, jnp.exp(s_tile - lse[:, None]), 0.0)
+        dv_j = p.T @ dof                                     # (BK, D)
+        dp = dof @ vj.T                                      # (S, BK)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dk_j = ds.T @ (q.astype(jnp.float32))                # (BK, D)
+        dq_acc = dq_acc + ds @ kj
+        return dq_acc, (dk_j, dv_j)
+
+    dq, (dk_b, dv_b) = jax.lax.scan(
+        per_block, jnp.zeros((s_len, d), jnp.float32), jnp.arange(n_blocks))
+    dk = dk_b.reshape(n_blocks * bk, d)[:s_len]
+    dv = dv_b.reshape(n_blocks * bk, d)[:s_len]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
+                    block_k: int = 128, interpret: bool | None = None):
+    """Pallas flash attention. q/k/v: ``[B, H, S, D]`` → ``[B, H, S, D]``.
+
+    ``interpret=None`` auto-selects: compiled kernel on TPU, interpreter
+    elsewhere (CPU tests). Same (q, k, v, causal=...) signature as
+    ``parallel.dense_attention``, so it drops into ``LlamaModel(attn_fn=…)``.
+    """
+    o, _ = _fwd(q, k, v, causal, block_q, block_k, _resolve(interpret))
+    return o
+
+
+def _resolve(interpret: bool | None) -> bool:
+    if interpret is None:
+        return jax.default_backend() not in ("tpu",)
+    return interpret
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    o, lse = _fwd(q, k, v, causal, block_q, block_k, _resolve(interpret))
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    bwd = functools.partial(_bwd_one_head, causal=causal, block_k=block_k,
+                            sm_scale=sm_scale)
+    # vmap over batch then heads
+    dq, dk, dv = jax.vmap(jax.vmap(bwd))(q, k, v, o, lse, do)
+    return dq, dk, dv
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -52,6 +52,24 @@ def test_pack_images_variable_sizes():
         assert np.abs(out[i] - ref).max() < 1e-3
 
 
+def test_bgra_flip_native_and_python_paths_agree():
+    """c=4 flip must be BGRA→RGBA (alpha preserved) on EVERY path."""
+    from sparkdl_tpu.image import imageIO
+
+    rng = np.random.RandomState(9)
+    arr = rng.randint(0, 256, (5, 5, 4)).astype(np.uint8)
+    structs = [imageIO.imageArrayToStruct(arr)]
+    nat = imageIO.structsToNHWC(structs)  # native path (float32 + uint8)
+    py = imageIO.structsToNHWC(structs, dtype=np.float64).astype(np.float32)
+    np.testing.assert_allclose(nat, py)
+    assert np.allclose(nat[0][..., 3], arr[..., 3])   # alpha stays channel 3
+    assert np.allclose(nat[0][..., 0], arr[..., 2])   # B<->R swapped
+    # round-trip: NHWC (RGBA) → structs (BGRA) → NHWC
+    back = imageIO.structsToNHWC(imageIO.nhwcToStructs(
+        nat.astype(np.uint8)))
+    np.testing.assert_allclose(back, nat)
+
+
 def test_pack_images_bgra_alpha_preserved():
     rng = np.random.RandomState(3)
     b = rng.randint(0, 256, (2, 4, 4, 4)).astype(np.uint8)
