@@ -1,6 +1,9 @@
-"""Parallelism toolkit: sharding rules (DP/TP/LoRA) and sequence parallelism
-(ring attention, Ulysses). See sharding.py and ring_attention.py."""
+"""Parallelism toolkit: sharding rules (DP/TP/LoRA), sequence parallelism
+(ring attention, Ulysses), and pipeline parallelism (GPipe over a mesh
+axis). See sharding.py, ring_attention.py, pipeline.py."""
 
+from .pipeline import (gpipe, microbatch, stack_stage_params,
+                       stage_sharding)
 from .ring_attention import (dense_attention, ring_attention,
                              ulysses_attention)
 from .sharding import (describe, lora_rules, make_rules, shard_params,
@@ -10,4 +13,5 @@ __all__ = [
     "make_rules", "shard_params", "sharding_pytree", "describe",
     "transformer_tp_rules", "lora_rules",
     "ring_attention", "ulysses_attention", "dense_attention",
+    "gpipe", "microbatch", "stack_stage_params", "stage_sharding",
 ]

@@ -1,0 +1,111 @@
+"""Pipeline parallelism — GPipe-style microbatch schedule over a mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2.4: "optional; not
+required by any [BASELINE] config") — this module completes the framework's
+parallelism inventory the TPU way: one SPMD program under ``shard_map`` where
+every device owns one *stage* (a contiguous slice of layers) and activations
+hop stage→stage over ICI via ``jax.lax.ppermute``.
+
+Design:
+- ``P`` stages, ``M`` microbatches, schedule length ``M + P - 1``: device
+  ``p`` computes microbatch ``t - p`` at tick ``t`` (the classic GPipe
+  pipeline with its (P-1)/M bubble).
+- The schedule is a ``lax.fori_loop`` of uniform ticks — static shapes, no
+  data-dependent control flow, exactly what XLA wants.
+- The whole schedule is differentiable: the transpose of ``ppermute`` is the
+  reverse hop, so ``jax.grad`` of a pipelined loss runs the reverse schedule
+  automatically — no hand-written backward pipeline. Stage calls are wrapped
+  in ``jax.checkpoint`` so the backward rematerializes instead of storing
+  every tick's activations.
+- Stages must map a hidden state to the same-shaped hidden state (the
+  transformer-decoder regime); embed/head live outside the pipelined region.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def stack_stage_params(per_stage_params: list) -> object:
+    """[stage0_tree, stage1_tree, ...] → one tree with a leading stage axis
+    (shard it over the pp axis with ``stage_sharding``)."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage_params)
+
+
+def stage_sharding(mesh: Mesh, params_stacked, axis: str = "pp"):
+    """Place the stacked stage axis on the pipeline mesh axis."""
+    def put(leaf):
+        spec = P(axis, *([None] * (leaf.ndim - 1)))
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+    return jax.tree_util.tree_map(put, params_stacked)
+
+
+def gpipe(stage_fn: Callable, mesh: Mesh, axis: str = "pp",
+          remat: bool = True) -> Callable:
+    """Build the pipelined apply: ``fn(params_stacked, x) -> y``.
+
+    ``stage_fn(stage_params, h) -> h`` runs ONE stage on one microbatch.
+    ``params_stacked``: pytree with leading stage axis (len = mesh[axis]),
+    sharded via ``stage_sharding``. ``x``: (M, mb, ...) microbatched input,
+    replicated across the pipeline axis. Returns (M, mb, ...) outputs.
+    """
+    n_stages = mesh.shape[axis]
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def shard_body(params_local, x):
+        # params_local: leading axis 1 (this device's stage); x: (M, mb, ...)
+        stage_params = jax.tree_util.tree_map(lambda l: l[0], params_local)
+        rank = jax.lax.axis_index(axis)
+        m = x.shape[0]
+        ticks = m + n_stages - 1
+        fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def tick(t, carry):
+            h, out = carry
+            # stage 0 injects microbatch t (garbage after m ticks — masked
+            # out by the write guard at the tail of the pipe)
+            mb_idx = jnp.clip(t, 0, m - 1)
+            h = jnp.where(rank == 0, x[mb_idx], h)
+            h = fn(stage_params, h)
+            # last stage emits microbatch t-(P-1) at tick t
+            out_idx = jnp.clip(t - (n_stages - 1), 0, m - 1)
+            emit = (rank == n_stages - 1) & (t >= n_stages - 1)
+            out = jax.lax.cond(
+                emit,
+                lambda o: jax.lax.dynamic_update_index_in_dim(
+                    o, h, out_idx, 0),
+                lambda o: o, out)
+            # hand activations to the next stage (ring hop over ICI)
+            h = jax.lax.ppermute(h, axis, fwd)
+            return h, out
+
+        h0 = jnp.zeros_like(x[0])
+        out0 = jnp.zeros_like(x)
+        _, out = jax.lax.fori_loop(0, ticks, tick, (h0, out0))
+        # only the last stage ever wrote to ``out`` (all others hold zeros):
+        # psum over the pipe axis replicates the real block to every device
+        return jax.lax.psum(out, axis)
+
+    def apply(params_stacked, x):
+        return jax.shard_map(
+            shard_body, mesh=mesh,
+            in_specs=(P(axis), P(*([None] * x.ndim))),
+            out_specs=P(*([None] * x.ndim)),
+            check_vma=False,
+        )(params_stacked, x)
+
+    return apply
+
+
+def microbatch(x, num_microbatches: int):
+    """(N, ...) → (M, N/M, ...) for the gpipe input contract."""
+    n = x.shape[0]
+    if n % num_microbatches:
+        raise ValueError(
+            f"Batch {n} not divisible into {num_microbatches} microbatches")
+    return x.reshape(num_microbatches, n // num_microbatches, *x.shape[1:])

@@ -11,8 +11,13 @@ from .train_state import (TrainState, bn_classifier_loss, make_eval_step,
                           softmax_cross_entropy_loss, state_sharding)
 from .xla_runner import RunnerContext, XlaRunner, current_context
 
+# Drop-in name for reference users: HorovodRunner(np=N).run(main_fn) — the
+# same constructor/run shape (SURVEY.md §3.5), executing as SPMD over the
+# device mesh with the allreduce compiled into the step function.
+HorovodRunner = XlaRunner
+
 __all__ = [
-    "XlaRunner", "RunnerContext", "current_context",
+    "XlaRunner", "HorovodRunner", "RunnerContext", "current_context",
     "TrainState", "make_train_step", "make_shard_map_step", "make_eval_step",
     "state_sharding", "softmax_cross_entropy_loss", "bn_classifier_loss",
     "CheckpointManager", "save_portable", "load_portable",
