@@ -21,6 +21,7 @@ TPU-first design:
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Any, Callable, Optional
 
@@ -119,7 +120,7 @@ class LlamaAttention(nn.Module):
     attn_fn: Optional[Callable] = None  # (q,k,v,causal=...) → o
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, decode: bool = False):
         c, d = self.cfg, self.dtype
         B, S, _ = x.shape
         hd = c.head_dim
@@ -134,21 +135,67 @@ class LlamaAttention(nn.Module):
         k = proj("k_proj", c.num_kv_heads, "k_proj" in c.lora_targets)
         v = proj("v_proj", c.num_kv_heads, "v_proj" in c.lora_targets)
 
-        q = rope(q, positions, c.rope_theta)
-        k = rope(k, positions, c.rope_theta)
-        if c.num_kv_heads != c.num_heads:  # GQA: tile KV heads (static)
-            rep = c.num_heads // c.num_kv_heads
-            k = jnp.repeat(k, rep, axis=1)
-            v = jnp.repeat(v, rep, axis=1)
+        rep = c.num_heads // c.num_kv_heads  # GQA tiling factor (static)
 
-        if self.attn_fn is not None:
-            o = self.attn_fn(q, k, v, causal=True)
+        if decode:
+            # KV-cache serving path. The cache is sized by the *init* call's
+            # sequence length (= max_len); apply() calls then write chunks —
+            # the whole prompt at prefill, one token per decode step — at the
+            # running index. See ``init_cache``/``generate``.
+            # NB: ``attn_fn`` (ring/Ulysses/flash) applies to the training
+            # path only; cache attention is computed here. Sequence-parallel
+            # serving is a future kernel (cache-aware flash decode).
+            if self.attn_fn is not None and not self.is_initializing():
+                import logging
+                logging.getLogger(__name__).warning(
+                    "LlamaAttention: attn_fn is ignored in decode mode; "
+                    "generation uses dense cache attention")
+            k_cache = self.variable("cache", "k", jnp.zeros,
+                                    (B, c.num_kv_heads, S, hd), d)
+            v_cache = self.variable("cache", "v", jnp.zeros,
+                                    (B, c.num_kv_heads, S, hd), d)
+            idx = self.variable("cache", "idx",
+                                lambda: jnp.zeros((), jnp.int32))
+            if not self.is_initializing():
+                cur = idx.value
+                pos = cur + jnp.arange(S)
+                q = rope(q, pos, c.rope_theta)
+                k = rope(k, pos, c.rope_theta)
+                k_all = jax.lax.dynamic_update_slice(
+                    k_cache.value, k, (0, 0, cur, 0))
+                v_all = jax.lax.dynamic_update_slice(
+                    v_cache.value, v, (0, 0, cur, 0))
+                k_cache.value, v_cache.value = k_all, v_all
+                idx.value = cur + S
+                # grouped-query attention against the UNtiled cache: fold
+                # the GQA tiling into the einsum group axis instead of
+                # jnp.repeat-copying the whole cache every step
+                max_len = k_all.shape[2]
+                qg = q.reshape(B, c.num_kv_heads, rep, S, hd)
+                s = jnp.einsum("bgrqd,bgkd->bgrqk", qg,
+                               k_all) / math.sqrt(hd)
+                col = jnp.arange(max_len)[None, :]
+                row = cur + jnp.arange(S)[:, None]
+                s = jnp.where(col <= row, s.astype(jnp.float32), -1e30)
+                p = jax.nn.softmax(s, axis=-1).astype(d)
+                o = jnp.einsum("bgrqk,bgkd->bgrqd", p, v_all).reshape(
+                    B, c.num_heads, S, hd)
+            else:
+                o = jnp.zeros((B, c.num_heads, S, hd), d)
         else:
-            s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(hd)
-            mask = jnp.tril(jnp.ones((S, S), bool))
-            s = jnp.where(mask, s.astype(jnp.float32), -1e30)
-            p = jax.nn.softmax(s, axis=-1).astype(d)
-            o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+            q = rope(q, positions, c.rope_theta)
+            k = rope(k, positions, c.rope_theta)
+            if rep != 1:
+                k = jnp.repeat(k, rep, axis=1)
+                v = jnp.repeat(v, rep, axis=1)
+            if self.attn_fn is not None:
+                o = self.attn_fn(q, k, v, causal=True)
+            else:
+                s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(hd)
+                mask = jnp.tril(jnp.ones((S, S), bool))
+                s = jnp.where(mask, s.astype(jnp.float32), -1e30)
+                p = jax.nn.softmax(s, axis=-1).astype(d)
+                o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
         o = o.transpose(0, 2, 1, 3).reshape(B, S, c.num_heads * hd)
         return LoRADense(c.hidden_size, rank=c.lora_rank if "o_proj" in
@@ -179,10 +226,10 @@ class LlamaLayer(nn.Module):
     attn_fn: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, decode: bool = False):
         c = self.cfg
         x = x + LlamaAttention(c, self.dtype, self.attn_fn, name="attn")(
-            RMSNorm(c.rms_norm_eps, name="attn_norm")(x), positions)
+            RMSNorm(c.rms_norm_eps, name="attn_norm")(x), positions, decode)
         x = x + LlamaMLP(c, self.dtype, name="mlp")(
             RMSNorm(c.rms_norm_eps, name="mlp_norm")(x))
         return x
@@ -195,7 +242,7 @@ class LlamaModel(nn.Module):
     attn_fn: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, input_ids):
+    def __call__(self, input_ids, decode: bool = False):
         c = self.cfg
         S = input_ids.shape[1]
         positions = jnp.arange(S)
@@ -203,10 +250,90 @@ class LlamaModel(nn.Module):
                      name="embed_tokens")(input_ids)
         for i in range(c.num_layers):
             x = LlamaLayer(c, self.dtype, self.attn_fn,
-                           name=f"layer_{i}")(x, positions)
+                           name=f"layer_{i}")(x, positions, decode)
         x = RMSNorm(c.rms_norm_eps, name="final_norm")(x)
         return nn.Dense(c.vocab_size, use_bias=False, dtype=jnp.float32,
                         name="lm_head")(x)
+
+
+# ---------------------------------------------------------------------------
+# Generation (KV-cache serving — the registerUDF inference path of
+# BASELINE config 5)
+# ---------------------------------------------------------------------------
+
+def init_cache(model: LlamaModel, batch_size: int, max_len: int):
+    """Zeroed KV cache pytree sized (batch, kv_heads, max_len, head_dim) per
+    layer. Built via ``jax.eval_shape`` over ``init`` — no parameter compute,
+    just the variable-tree structure."""
+    shapes = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((batch_size, max_len), jnp.int32),
+                           decode=True))
+    return jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"])
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "max_new_tokens", "temperature", "max_len"))
+def _generate_impl(model, params, prompt_ids, rng, *, max_new_tokens: int,
+                   temperature: float, max_len: int):
+    b = prompt_ids.shape[0]
+    cache = init_cache(model, b, max_len)
+
+    def sample(logits, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            key, logits / temperature, axis=-1).astype(jnp.int32)
+
+    # prefill: whole prompt in one chunk
+    logits, mut = model.apply({"params": params, "cache": cache},
+                              prompt_ids, decode=True, mutable=["cache"])
+    rng, key = jax.random.split(rng)
+    tok = sample(logits[:, -1].astype(jnp.float32), key)
+
+    # each scan step emits the already-sampled token and samples the next;
+    # after n steps the emitted sequence is exactly the n new tokens
+    def step(carry, _):
+        cache, tok, rng = carry
+        logits, mut = model.apply({"params": params, "cache": cache},
+                                  tok[:, None], decode=True,
+                                  mutable=["cache"])
+        rng, key = jax.random.split(rng)
+        nxt = sample(logits[:, -1].astype(jnp.float32), key)
+        return (mut["cache"], nxt, rng), tok
+
+    _, toks = jax.lax.scan(
+        step, (mut["cache"], tok, rng), None, length=max_new_tokens)
+    return jnp.concatenate([prompt_ids, jnp.moveaxis(toks, 0, 1)], axis=1)
+
+
+def generate(model: LlamaModel, variables, prompt_ids, max_new_tokens: int,
+             temperature: float = 0.0, rng=None, pad_to: int | None = None):
+    """Greedy / temperature sampling with a KV cache.
+
+    The whole generation is ONE jitted program: a prefill pass writes the
+    prompt's cache in a single chunked update, then ``lax.scan`` decodes one
+    token per step. The jit cache is keyed on (model, shapes, max_new_tokens,
+    temperature, max_len) — pass ``pad_to`` to share one compiled decode
+    across varying prompt lengths (cache length stays constant).
+
+    ``prompt_ids``: [B, Lp] int32. Returns [B, Lp + max_new_tokens].
+    """
+    prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+    lp = prompt_ids.shape[1]
+    max_len = pad_to or (lp + max_new_tokens)
+    if max_len < lp + max_new_tokens:
+        raise ValueError(f"pad_to={pad_to} < prompt+new ="
+                         f" {lp + max_new_tokens}")
+    params = variables["params"] if "params" in variables else variables
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    return _generate_impl(model, params, prompt_ids, rng,
+                          max_new_tokens=int(max_new_tokens),
+                          temperature=float(temperature),
+                          max_len=int(max_len))
 
 
 # ---------------------------------------------------------------------------

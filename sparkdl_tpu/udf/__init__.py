@@ -1,5 +1,6 @@
-from .registry import (applyUDF, listUDFs, registerImageUDF,
-                       registerKerasImageUDF, registerUDF, unregisterUDF)
+from .registry import (applyUDF, listUDFs, registerGenerationUDF,
+                       registerImageUDF, registerKerasImageUDF, registerUDF,
+                       unregisterUDF)
 
 __all__ = ["registerUDF", "registerImageUDF", "registerKerasImageUDF",
-           "applyUDF", "listUDFs", "unregisterUDF"]
+           "registerGenerationUDF", "applyUDF", "listUDFs", "unregisterUDF"]

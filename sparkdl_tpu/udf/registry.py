@@ -84,6 +84,52 @@ def registerKerasImageUDF(udf_name: str, keras_model_or_file,
 
 
 
+def registerGenerationUDF(name: str, model, variables,
+                          max_new_tokens: int = 32,
+                          temperature: float = 0.0, seed: int = 0) -> None:
+    """Register a text-generation UDF over token-id columns — the
+    ``registerUDF`` batch-inference half of BASELINE config 5 ("Llama LoRA
+    fine-tune via XlaRunner + registerUDF batch inference").
+
+    The column holds int token-id lists (prompts). Rows are grouped by
+    prompt length and each group decodes as ONE compiled KV-cache program
+    (prefill + lax.scan) — two XLA programs per distinct prompt length.
+    """
+    import jax
+    import numpy as np
+
+    from ..models.llama import generate
+
+    def apply(df: DataFrame, inputCol: str, outputCol: str) -> DataFrame:
+        import pandas as pd
+        pdf = df.toPandas()
+        prompts = [np.asarray(p, dtype=np.int32)
+                   for p in pdf[inputCol].to_list()]
+        out: list = [None] * len(prompts)
+        by_len: dict[int, list[int]] = {}
+        for i, p in enumerate(prompts):
+            by_len.setdefault(len(p), []).append(i)
+        # shared cache length across all groups: every group reuses ONE
+        # compiled decode program (prefill still compiles per distinct
+        # prompt length — that's inherent without attention masks)
+        pad_to = max(by_len) + max_new_tokens if by_len else 0
+        rng = jax.random.PRNGKey(seed)
+        for _, idxs in sorted(by_len.items()):
+            batch = np.stack([prompts[i] for i in idxs])
+            rng, key = jax.random.split(rng)
+            gen = np.asarray(generate(model, variables, batch,
+                                      max_new_tokens,
+                                      temperature=temperature, rng=key,
+                                      pad_to=pad_to))
+            for row, i in enumerate(idxs):
+                out[i] = gen[row].tolist()
+        pdf = pdf.copy()
+        pdf[outputCol] = pd.Series(out, index=pdf.index)
+        return DataFrame.fromPandas(pdf, numPartitions=df.numPartitions)
+
+    _UDF_REGISTRY[name] = apply
+
+
 def applyUDF(df: DataFrame, name: str, inputCol: str,
              outputCol: str) -> DataFrame:
     try:

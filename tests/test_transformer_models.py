@@ -236,3 +236,56 @@ class TestLlama:
             data=data(), num_steps=8, log_every=2))
         losses = [h["loss"] for h in res["history"]]
         assert losses[-1] < losses[0]
+
+
+class TestLlamaGeneration:
+    """KV-cache decode (models/llama.py generate) and the generation UDF."""
+
+    def _setup(self):
+        from sparkdl_tpu.models.llama import LlamaConfig, LlamaModel
+        cfg = LlamaConfig.tiny()
+        model = LlamaModel(cfg)
+        ids = jnp.asarray(np.random.RandomState(0).randint(
+            0, cfg.vocab_size, (2, 8)), jnp.int32)
+        variables = model.init(jax.random.PRNGKey(0), ids)
+        return cfg, model, variables, ids
+
+    def test_kv_cache_matches_full_reforward(self):
+        from sparkdl_tpu.models.llama import generate
+        cfg, model, variables, ids = self._setup()
+        cur = ids
+        for _ in range(5):
+            logits = model.apply(variables, cur)
+            nxt = jnp.argmax(logits[:, -1].astype(jnp.float32),
+                             -1).astype(jnp.int32)
+            cur = jnp.concatenate([cur, nxt[:, None]], axis=1)
+        out = generate(model, variables, ids, 5)
+        assert (np.asarray(out) == np.asarray(cur)).all()
+
+    def test_pad_to_and_errors(self):
+        from sparkdl_tpu.models.llama import generate
+        cfg, model, variables, ids = self._setup()
+        out = generate(model, variables, ids, 3, pad_to=32)
+        assert out.shape == (2, 11)
+        with pytest.raises(ValueError, match="pad_to"):
+            generate(model, variables, ids, 5, pad_to=10)
+
+    def test_generation_udf_groups_by_length(self):
+        import pandas as pd
+
+        import sparkdl_tpu as sdl
+        from sparkdl_tpu.udf import registerGenerationUDF, unregisterUDF
+
+        cfg, model, variables, _ = self._setup()
+        rng = np.random.RandomState(1)
+        prompts = [rng.randint(0, cfg.vocab_size, n).tolist()
+                   for n in (5, 8, 5, 3)]
+        df = sdl.DataFrame.fromPandas(pd.DataFrame({"prompt": prompts}))
+        registerGenerationUDF("gen", model, variables, max_new_tokens=4)
+        try:
+            out = sdl.applyUDF(df, "gen", "prompt", "completion").toPandas()
+        finally:
+            unregisterUDF("gen")
+        for p, c in zip(prompts, out["completion"]):
+            assert len(c) == len(p) + 4
+            assert list(c[:len(p)]) == p
