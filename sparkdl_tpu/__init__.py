@@ -14,12 +14,17 @@ the survey + BASELINE.json are the spec).
 
 __version__ = "0.1.0"
 
-from .core import (DataFrame, Estimator, Evaluator, HasBatchSize, HasInputCol,
-                   HasLabelCol, HasOutputCol, HasPredictionCol, HasSeed,
-                   MLWritable, Model, Param, Params, Pipeline, PipelineModel,
-                   Row, Transformer, TypeConverters, keyword_only, load)
-from .estimators import (KerasImageFileEstimator, LogisticRegression,
-                         LogisticRegressionModel)
+from .core import (CrossValidator, CrossValidatorModel, DataFrame, Estimator,
+                   Evaluator, HasBatchSize, HasInputCol, HasLabelCol,
+                   HasOutputCol, HasPredictionCol, HasSeed, MLWritable, Model,
+                   Param, ParamGridBuilder, Params, Pipeline, PipelineModel,
+                   Row, TrainValidationSplit, TrainValidationSplitModel,
+                   Transformer, TypeConverters, keyword_only, load)
+from .estimators import (BinaryClassificationEvaluator,
+                         KerasImageFileEstimator, LogisticRegression,
+                         LogisticRegressionModel,
+                         MulticlassClassificationEvaluator,
+                         RegressionEvaluator)
 from .graph import (GraphFunction, IsolatedSession, TFInputGraph,
                     XlaInputGraph, buildFlattener, buildSpImageConverter,
                     makeGraphUDF)
@@ -47,6 +52,10 @@ __all__ = [
     "KerasImageFileTransformer", "XlaTransformer", "TFTransformer",
     "KerasTransformer",
     "LogisticRegression", "LogisticRegressionModel",
+    "ParamGridBuilder", "CrossValidator", "CrossValidatorModel",
+    "TrainValidationSplit", "TrainValidationSplitModel",
+    "MulticlassClassificationEvaluator", "RegressionEvaluator",
+    "BinaryClassificationEvaluator",
     "KerasImageFileEstimator",
     "registerUDF", "registerImageUDF", "registerKerasImageUDF", "applyUDF",
     "listUDFs",

@@ -4,6 +4,8 @@ from .params import (Param, Params, TypeConverters, keyword_only,
 from .pipeline import (Transformer, Estimator, Model, Evaluator,
                        Pipeline, PipelineModel, MLWritable, load)
 from .frame import DataFrame, Row
+from .tuning import (CrossValidator, CrossValidatorModel, ParamGridBuilder,
+                     TrainValidationSplit, TrainValidationSplitModel)
 
 __all__ = [
     "Param", "Params", "TypeConverters", "keyword_only",
@@ -12,4 +14,6 @@ __all__ = [
     "Transformer", "Estimator", "Model", "Evaluator",
     "Pipeline", "PipelineModel", "MLWritable", "load",
     "DataFrame", "Row",
+    "ParamGridBuilder", "CrossValidator", "CrossValidatorModel",
+    "TrainValidationSplit", "TrainValidationSplitModel",
 ]

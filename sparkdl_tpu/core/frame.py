@@ -215,6 +215,25 @@ class DataFrame:
     def numPartitions(self) -> int:
         return len(self._partitions)
 
+    def randomSplit(self, weights: Sequence[float],
+                    seed: int = 0) -> list["DataFrame"]:
+        """Random row split by ``weights`` (Spark API; normalizes weights).
+        Materializes the table once, permutes rows with the seeded PRNG."""
+        import numpy as np
+        if not weights or any(w <= 0 for w in weights):
+            raise ValueError(f"weights must be positive, got {weights}")
+        table = self.toArrow()
+        n = table.num_rows
+        perm = np.random.RandomState(seed).permutation(n)
+        total = float(sum(weights))
+        bounds = np.cumsum([w / total for w in weights])[:-1]
+        cuts = [int(round(b * n)) for b in bounds]
+        out = []
+        for idxs in np.split(perm, cuts):
+            out.append(DataFrame.fromArrow(
+                table.take(pa.array(np.sort(idxs)))))
+        return out
+
     def toArrow(self) -> pa.Table:
         batches = [b for b in self.iterPartitions()]
         # Zero-row batches can carry degenerate column types (an op cannot

@@ -1,5 +1,9 @@
+from .evaluation import (BinaryClassificationEvaluator,
+                         MulticlassClassificationEvaluator,
+                         RegressionEvaluator)
 from .keras_image_file_estimator import KerasImageFileEstimator
 from .logistic_regression import LogisticRegression, LogisticRegressionModel
 
 __all__ = ["LogisticRegression", "LogisticRegressionModel",
-           "KerasImageFileEstimator"]
+           "KerasImageFileEstimator", "MulticlassClassificationEvaluator",
+           "RegressionEvaluator", "BinaryClassificationEvaluator"]

@@ -273,56 +273,64 @@ def init_cache(model: LlamaModel, batch_size: int, max_len: int):
         lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"])
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("model", "max_new_tokens", "temperature", "max_len"))
-def _generate_impl(model, params, prompt_ids, rng, *, max_new_tokens: int,
-                   temperature: float, max_len: int):
-    b = prompt_ids.shape[0]
-    cache = init_cache(model, b, max_len)
+def _sample(logits, key, temperature: float):
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        key, logits / temperature, axis=-1).astype(jnp.int32)
 
-    def sample(logits, key):
-        if temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            key, logits / temperature, axis=-1).astype(jnp.int32)
 
-    # prefill: whole prompt in one chunk
+@functools.partial(jax.jit, static_argnames=("model",))
+def _prefill(model, params, prompt_ids, cache):
+    """Whole prompt in one chunked cache write → (last-pos logits, cache).
+    Compiled per (batch, prompt_len, max_len) signature."""
     logits, mut = model.apply({"params": params, "cache": cache},
                               prompt_ids, decode=True, mutable=["cache"])
-    rng, key = jax.random.split(rng)
-    tok = sample(logits[:, -1].astype(jnp.float32), key)
+    return logits[:, -1].astype(jnp.float32), mut["cache"]
 
-    # each scan step emits the already-sampled token and samples the next;
-    # after n steps the emitted sequence is exactly the n new tokens
+
+@functools.partial(
+    jax.jit, static_argnames=("model", "max_new_tokens", "temperature"))
+def _decode(model, params, cache, last_logits, rng, *, max_new_tokens: int,
+            temperature: float):
+    """lax.scan: one token per step. Compiled per (batch, max_len)
+    signature — independent of the prompt length, so varying-length prompts
+    with a shared cache size reuse ONE decode program."""
+    rng, key = jax.random.split(rng)
+    tok = _sample(last_logits, key, temperature)
+
+    # each step emits the already-sampled token and samples the next; after
+    # n steps the emitted sequence is exactly the n new tokens
     def step(carry, _):
         cache, tok, rng = carry
         logits, mut = model.apply({"params": params, "cache": cache},
                                   tok[:, None], decode=True,
                                   mutable=["cache"])
         rng, key = jax.random.split(rng)
-        nxt = sample(logits[:, -1].astype(jnp.float32), key)
+        nxt = _sample(logits[:, -1].astype(jnp.float32), key, temperature)
         return (mut["cache"], nxt, rng), tok
 
     _, toks = jax.lax.scan(
-        step, (mut["cache"], tok, rng), None, length=max_new_tokens)
-    return jnp.concatenate([prompt_ids, jnp.moveaxis(toks, 0, 1)], axis=1)
+        step, (cache, tok, rng), None, length=max_new_tokens)
+    return jnp.moveaxis(toks, 0, 1)
 
 
 def generate(model: LlamaModel, variables, prompt_ids, max_new_tokens: int,
              temperature: float = 0.0, rng=None, pad_to: int | None = None):
     """Greedy / temperature sampling with a KV cache.
 
-    The whole generation is ONE jitted program: a prefill pass writes the
-    prompt's cache in a single chunked update, then ``lax.scan`` decodes one
-    token per step. The jit cache is keyed on (model, shapes, max_new_tokens,
-    temperature, max_len) — pass ``pad_to`` to share one compiled decode
-    across varying prompt lengths (cache length stays constant).
+    Two jitted programs: a prefill pass writes the prompt's cache in a
+    single chunked update (compiled per prompt length), then a ``lax.scan``
+    decode emits one token per step (compiled per (batch, cache-size) only —
+    pass ``pad_to`` to fix the cache size so varying prompt lengths share
+    one decode program).
 
-    ``prompt_ids``: [B, Lp] int32. Returns [B, Lp + max_new_tokens].
+    ``prompt_ids``: [B, Lp] int32, Lp >= 1. Returns [B, Lp+max_new_tokens].
     """
     prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
-    lp = prompt_ids.shape[1]
+    b, lp = prompt_ids.shape
+    if lp < 1:
+        raise ValueError("prompt_ids must contain at least one token")
     max_len = pad_to or (lp + max_new_tokens)
     if max_len < lp + max_new_tokens:
         raise ValueError(f"pad_to={pad_to} < prompt+new ="
@@ -330,10 +338,12 @@ def generate(model: LlamaModel, variables, prompt_ids, max_new_tokens: int,
     params = variables["params"] if "params" in variables else variables
     if rng is None:
         rng = jax.random.PRNGKey(0)
-    return _generate_impl(model, params, prompt_ids, rng,
-                          max_new_tokens=int(max_new_tokens),
-                          temperature=float(temperature),
-                          max_len=int(max_len))
+    cache = init_cache(model, b, int(max_len))
+    last_logits, cache = _prefill(model, params, prompt_ids, cache)
+    toks = _decode(model, params, cache, last_logits, rng,
+                   max_new_tokens=int(max_new_tokens),
+                   temperature=float(temperature))
+    return jnp.concatenate([prompt_ids, toks], axis=1)
 
 
 # ---------------------------------------------------------------------------

@@ -108,14 +108,23 @@ def registerGenerationUDF(name: str, model, variables,
         out: list = [None] * len(prompts)
         by_len: dict[int, list[int]] = {}
         for i, p in enumerate(prompts):
+            if len(p) == 0:
+                raise ValueError(
+                    f"{inputCol!r} row {i} is an empty prompt; every row "
+                    f"needs at least one token id")
             by_len.setdefault(len(p), []).append(i)
-        # shared cache length across all groups: every group reuses ONE
-        # compiled decode program (prefill still compiles per distinct
-        # prompt length — that's inherent without attention masks)
+        # One compiled decode program for ALL groups: fix the cache size
+        # (pad_to) and pad each group's batch to a common row count with
+        # repeated rows (discarded after). Prefill still compiles once per
+        # distinct prompt length — inherent without attention masks.
         pad_to = max(by_len) + max_new_tokens if by_len else 0
+        batch_rows = max(len(v) for v in by_len.values()) if by_len else 0
         rng = jax.random.PRNGKey(seed)
         for _, idxs in sorted(by_len.items()):
             batch = np.stack([prompts[i] for i in idxs])
+            if len(idxs) < batch_rows:
+                fill = np.repeat(batch[:1], batch_rows - len(idxs), axis=0)
+                batch = np.concatenate([batch, fill], axis=0)
             rng, key = jax.random.split(rng)
             gen = np.asarray(generate(model, variables, batch,
                                       max_new_tokens,

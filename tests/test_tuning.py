@@ -1,0 +1,153 @@
+"""Tuning (ParamGridBuilder / CrossValidator / TrainValidationSplit) and
+evaluator tests — the param-grid workflow the reference's fitMultiple serves
+(SURVEY.md §2.1)."""
+
+import numpy as np
+import pytest
+
+import sparkdl_tpu as sdl
+
+
+def _toy_classification(n=120, seed=0):
+    """Linearly separable-ish 2-class data in a features column."""
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 4).astype(np.float32)
+    w = np.array([2.0, -1.0, 0.5, 0.0], np.float32)
+    y = (x @ w + 0.3 * rng.randn(n) > 0).astype(np.int64)
+    return sdl.DataFrame.fromPydict(
+        {"features": [r.tolist() for r in x], "label": y.tolist()},
+        numPartitions=2)
+
+
+def test_param_grid_builder():
+    lr = sdl.LogisticRegression()
+    grid = (sdl.ParamGridBuilder()
+            .addGrid(lr.maxIter, [5, 10])
+            .addGrid(lr.stepSize, [0.1, 0.5])
+            .build())
+    assert len(grid) == 4
+    assert {frozenset((p.name, v) for p, v in g.items()) for g in grid} == {
+        frozenset([("maxIter", 5), ("stepSize", 0.1)]),
+        frozenset([("maxIter", 5), ("stepSize", 0.5)]),
+        frozenset([("maxIter", 10), ("stepSize", 0.1)]),
+        frozenset([("maxIter", 10), ("stepSize", 0.5)]),
+    }
+    based = (sdl.ParamGridBuilder()
+             .baseOn({lr.maxIter: 7})
+             .addGrid(lr.stepSize, [0.1, 0.2]).build())
+    assert all(g[lr.maxIter] == 7 for g in based)
+
+
+def test_random_split():
+    df = _toy_classification(100)
+    a, b = df.randomSplit([0.7, 0.3], seed=1)
+    assert a.count() + b.count() == 100
+    assert 60 <= a.count() <= 80
+    # deterministic
+    a2, _ = df.randomSplit([0.7, 0.3], seed=1)
+    assert [r.label for r in a.collect()] == [r.label for r in a2.collect()]
+    with pytest.raises(ValueError, match="positive"):
+        df.randomSplit([0.5, -0.5])
+
+
+def test_multiclass_evaluator_metrics():
+    df = sdl.DataFrame.fromPydict({
+        "label": [0, 0, 1, 1, 2, 2],
+        "prediction": [0, 1, 1, 1, 2, 0],
+    })
+    ev = sdl.MulticlassClassificationEvaluator()
+    assert ev.evaluate(df) == pytest.approx(4 / 6)
+    f1 = sdl.MulticlassClassificationEvaluator(metricName="f1")
+    assert 0.0 < f1.evaluate(df) < 1.0
+    with pytest.raises(ValueError, match="Unknown metricName"):
+        sdl.MulticlassClassificationEvaluator(metricName="nope").evaluate(df)
+
+
+def test_regression_evaluator_metrics():
+    df = sdl.DataFrame.fromPydict({
+        "label": [1.0, 2.0, 3.0], "prediction": [1.0, 2.0, 5.0]})
+    assert sdl.RegressionEvaluator(metricName="mae").evaluate(df) == \
+        pytest.approx(2 / 3)
+    assert sdl.RegressionEvaluator(metricName="rmse").evaluate(df) == \
+        pytest.approx(np.sqrt(4 / 3))
+    r2 = sdl.RegressionEvaluator(metricName="r2")
+    assert r2.isLargerBetter() and r2.evaluate(df) < 1.0
+    assert not sdl.RegressionEvaluator(metricName="rmse").isLargerBetter()
+
+
+def test_binary_evaluator_auc():
+    df = sdl.DataFrame.fromPydict({
+        "label": [0, 0, 1, 1],
+        "probability": [0.1, 0.4, 0.35, 0.8]})
+    auc = sdl.BinaryClassificationEvaluator().evaluate(df)
+    assert auc == pytest.approx(0.75)
+    # perfect separation
+    df2 = sdl.DataFrame.fromPydict({
+        "label": [0, 0, 1, 1], "probability": [0.1, 0.2, 0.8, 0.9]})
+    assert sdl.BinaryClassificationEvaluator().evaluate(df2) == 1.0
+
+
+def test_cross_validator_selects_reasonable_model():
+    df = _toy_classification()
+    lr = sdl.LogisticRegression(maxIter=30)
+    grid = (sdl.ParamGridBuilder()
+            .addGrid(lr.stepSize, [0.001, 0.5]).build())
+    cv = sdl.CrossValidator(
+        estimator=lr, estimatorParamMaps=grid,
+        evaluator=sdl.MulticlassClassificationEvaluator(), numFolds=3)
+    model = cv.fit(df)
+    assert len(model.avgMetrics) == 2
+    # the sane step size must beat the degenerate one, and the refit best
+    # model should classify the training data well
+    assert model.avgMetrics[1] > model.avgMetrics[0]
+    acc = sdl.MulticlassClassificationEvaluator().evaluate(
+        model.transform(df))
+    assert acc > 0.8
+
+
+def test_cross_validator_validation():
+    lr = sdl.LogisticRegression()
+    with pytest.raises(ValueError, match="must be set"):
+        sdl.CrossValidator(estimator=lr).fit(_toy_classification(20))
+    cv = sdl.CrossValidator(
+        estimator=lr, estimatorParamMaps=[{}],
+        evaluator=sdl.MulticlassClassificationEvaluator(), numFolds=1)
+    with pytest.raises(ValueError, match="numFolds"):
+        cv.fit(_toy_classification(20))
+
+
+def test_train_validation_split():
+    df = _toy_classification()
+    lr = sdl.LogisticRegression(maxIter=30)
+    grid = (sdl.ParamGridBuilder()
+            .addGrid(lr.stepSize, [0.001, 0.5]).build())
+    tvs = sdl.TrainValidationSplit(
+        estimator=lr, estimatorParamMaps=grid,
+        evaluator=sdl.MulticlassClassificationEvaluator(),
+        trainRatio=0.75)
+    model = tvs.fit(df)
+    assert len(model.validationMetrics) == 2
+    assert model.validationMetrics[1] > model.validationMetrics[0]
+    with pytest.raises(ValueError, match="trainRatio"):
+        sdl.TrainValidationSplit(
+            estimator=lr, estimatorParamMaps=grid,
+            evaluator=sdl.MulticlassClassificationEvaluator(),
+            trainRatio=1.5).fit(df)
+
+
+def test_cross_validator_model_persistence(tmp_path):
+    df = _toy_classification(60)
+    lr = sdl.LogisticRegression(maxIter=20)
+    cv = sdl.CrossValidator(
+        estimator=lr,
+        estimatorParamMaps=sdl.ParamGridBuilder()
+            .addGrid(lr.stepSize, [0.3, 0.5]).build(),
+        evaluator=sdl.MulticlassClassificationEvaluator(), numFolds=2)
+    model = cv.fit(df)
+    p = str(tmp_path / "cvm")
+    model.save(p)
+    loaded = sdl.load(p)
+    assert loaded.avgMetrics == model.avgMetrics
+    a = [r.prediction for r in model.transform(df).collect()]
+    b = [r.prediction for r in loaded.transform(df).collect()]
+    assert a == b
