@@ -1,7 +1,9 @@
 """Parallelism toolkit: sharding rules (DP/TP/LoRA), sequence parallelism
-(ring attention, Ulysses), and pipeline parallelism (GPipe over a mesh
-axis). See sharding.py, ring_attention.py, pipeline.py."""
+(ring attention, Ulysses), pipeline parallelism (GPipe over a mesh axis),
+and expert parallelism (Switch MoE). See sharding.py, ring_attention.py,
+pipeline.py, moe.py."""
 
+from .moe import SwitchMoE, moe_aux_loss, moe_rules
 from .pipeline import (gpipe, microbatch, stack_stage_params,
                        stage_sharding)
 from .ring_attention import (dense_attention, ring_attention,
@@ -14,4 +16,5 @@ __all__ = [
     "transformer_tp_rules", "lora_rules",
     "ring_attention", "ulysses_attention", "dense_attention",
     "gpipe", "microbatch", "stack_stage_params", "stage_sharding",
+    "SwitchMoE", "moe_rules", "moe_aux_loss",
 ]

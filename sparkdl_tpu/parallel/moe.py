@@ -1,0 +1,125 @@
+"""Expert parallelism — Switch/GShard-style mixture-of-experts.
+
+Absent from the reference (SURVEY.md §2.4: EP "out of scope; none of the
+[BASELINE] configs are MoE") — included to complete the parallelism
+inventory the TPU way: experts hold stacked parameters with a leading
+``(num_experts, ...)`` axis sharded over an ``ep`` mesh axis, and token
+routing is expressed as dense one-hot dispatch/combine einsums (the
+GShard formulation) — XLA lowers the sharded einsums to all_to_all-style
+collectives over ICI; no hand-written routing code.
+
+Top-1 (Switch) routing with capacity: each token goes to its argmax expert;
+tokens beyond ``capacity_factor * tokens/experts`` at an expert are dropped
+(pass through the residual). The load-balancing auxiliary loss is sowed
+into the ``intermediates`` collection as ``moe_aux_loss``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+class _ExpertFFN(nn.Module):
+    d_ff: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Dense(self.d_ff, dtype=self.dtype, name="wi")(x)
+        return nn.Dense(x.shape[-1], dtype=self.dtype, name="wo")(
+            nn.gelu(h))
+
+
+class SwitchMoE(nn.Module):
+    """Top-1 routed MoE FFN: (B, T, D) → (B, T, D).
+
+    Parameters live under ``experts`` with a leading num_experts axis —
+    shard with ``moe_rules`` (P("ep") on that axis).
+    """
+    num_experts: int
+    d_ff: int
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, d = x.shape
+        e = self.num_experts
+        n = b * t
+        cap = max(1, int(self.capacity_factor * n / e))
+        xf = x.reshape(n, d)
+
+        gate_logits = nn.Dense(e, dtype=jnp.float32, name="router")(
+            xf.astype(jnp.float32))                       # (N, E)
+        probs = jax.nn.softmax(gate_logits, axis=-1)
+        expert_idx = jnp.argmax(probs, axis=-1)           # (N,)
+        gate = jnp.max(probs, axis=-1)                    # (N,)
+
+        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # (N, E)
+        # position of each token in its expert's queue (0-based; -1 for
+        # not-this-expert, which one_hot maps to all-zeros)
+        pos = (jnp.cumsum(onehot, axis=0) * onehot - 1.0).astype(jnp.int32)
+        keep = (pos >= 0) & (pos < cap)
+        dispatch = jnp.where(keep, onehot, 0.0)           # (N, E)
+        slot = jax.nn.one_hot(pos, cap, dtype=jnp.float32)  # (N, E, C)
+        dispatch3 = dispatch[..., None] * slot            # (N, E, C)
+
+        # (E, C, D): the sharded-einsum boundary — with experts on "ep",
+        # XLA turns this into the token all_to_all
+        expert_in = jnp.einsum("nec,nd->ecd", dispatch3,
+                               xf.astype(jnp.float32)).astype(self.dtype)
+
+        experts = nn.vmap(
+            _ExpertFFN,
+            in_axes=0, out_axes=0,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+        )(self.d_ff, self.dtype, name="experts")
+        expert_out = experts(expert_in)                   # (E, C, D)
+
+        combine3 = dispatch3 * gate[:, None, None]        # (N, E, C)
+        out = jnp.einsum("nec,ecd->nd", combine3,
+                         expert_out.astype(jnp.float32))
+
+        # Switch load-balancing loss: E * sum_e(frac_tokens_e * mean_prob_e)
+        frac_tokens = jnp.mean(onehot, axis=0)
+        mean_probs = jnp.mean(probs, axis=0)
+        self.sow("intermediates", "moe_aux_loss",
+                 e * jnp.sum(frac_tokens * mean_probs))
+
+        return out.reshape(b, t, d).astype(x.dtype)
+
+
+def moe_rules(base_rules: Callable | None = None,
+              ep_axis: str = "ep") -> Callable:
+    """Sharding rules: expert-stacked params (path contains ``experts``)
+    get P(ep_axis) on the leading axis; everything else falls through to
+    ``base_rules`` (or replicated)."""
+    from .sharding import path_str
+
+    def rules(path, leaf) -> P:
+        # exact path-segment match, not substring: a layer named
+        # "experts_gate" must NOT be expert-sharded
+        if "experts" in path_str(path).split("/"):
+            return P(ep_axis, *([None] * (leaf.ndim - 1)))
+        if base_rules is not None:
+            return base_rules(path, leaf)
+        return P()
+
+    return rules
+
+
+def moe_aux_loss(intermediates) -> jnp.ndarray:
+    """Sum every sowed ``moe_aux_loss`` in an intermediates collection."""
+    from ..utils.trees import flatten_with_paths
+
+    total = 0.0
+    for path, leaf in flatten_with_paths(intermediates):
+        if "moe_aux_loss" in path.split("/"):
+            total = total + jnp.sum(leaf)
+    return jnp.asarray(total)

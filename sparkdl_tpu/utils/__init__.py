@@ -1,0 +1,6 @@
+"""Shared utilities: pytree path helpers and device-aware timing."""
+
+from .trees import flatten_with_paths, path_str, tree_size_bytes
+from .timing import Timer
+
+__all__ = ["flatten_with_paths", "path_str", "tree_size_bytes", "Timer"]

@@ -1,0 +1,25 @@
+"""Wall-clock timing with device-completion awareness."""
+
+from __future__ import annotations
+
+import time
+
+
+class Timer:
+    """``with Timer() as t: ...`` — blocks on ``block_on`` (a jax pytree)
+    before stopping, so device work is actually counted."""
+
+    def __init__(self, block_on=None):
+        self._block_on = block_on
+        self.seconds = 0.0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self._block_on is not None:
+            import jax
+            jax.block_until_ready(self._block_on)
+        self.seconds = time.perf_counter() - self._t0
+        return False

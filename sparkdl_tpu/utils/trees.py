@@ -1,0 +1,26 @@
+"""Pytree path helpers (the canonical "a/b/c" path spelling lives in
+parallel.sharding; these wrap it for generic use)."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from ..parallel.sharding import path_str  # canonical "a/b/c" spelling
+
+
+def flatten_with_paths(tree) -> list[tuple[str, object]]:
+    """[(\"a/b/c\", leaf), ...] in deterministic traversal order."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(path_str(path), leaf) for path, leaf in flat]
+
+
+def tree_size_bytes(tree) -> int:
+    """Total bytes across array leaves (params/cache accounting)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if hasattr(leaf, "nbytes"):
+            total += int(leaf.nbytes)
+        elif hasattr(leaf, "size") and hasattr(leaf, "dtype"):
+            total += int(leaf.size) * np.dtype(leaf.dtype).itemsize
+    return total

@@ -424,3 +424,28 @@ def test_make_graph_udf_kinds():
     finally:
         unregisterUDF("callable_udf")
         unregisterUDF("blob_udf")
+
+
+def test_image_input_placeholder_and_utils():
+    from sparkdl_tpu.transformers.utils import (IMAGE_INPUT_PLACEHOLDER_NAME,
+                                                imageInputPlaceholder,
+                                                imageInputSpec)
+    from sparkdl_tpu.utils import Timer, flatten_with_paths, tree_size_bytes
+
+    node = imageInputPlaceholder(3, 8, 8)
+    issn = node.session
+    out = issn.apply(lambda b: b.reshape(b.shape[0], -1), node)
+    gfn = issn.asGraphFunction([node], [out])
+    x = np.random.RandomState(0).rand(2, 8, 8, 3).astype(np.float32)
+    res = gfn({IMAGE_INPUT_PLACEHOLDER_NAME: x})
+    assert res[out.name].shape == (2, 192)
+    blob = gfn.serialize(imageInputSpec(8, 8))
+    assert GraphFunction.deserialize(blob)(
+        {IMAGE_INPUT_PLACEHOLDER_NAME: x})[out.name].shape == (2, 192)
+
+    tree = {"a": {"b": np.zeros((2, 2), np.float32)}, "c": np.zeros(3)}
+    assert dict(flatten_with_paths(tree))["a/b"].shape == (2, 2)
+    assert tree_size_bytes(tree) == 2 * 2 * 4 + 3 * 8
+    with Timer() as t:
+        pass
+    assert t.seconds >= 0.0
