@@ -156,8 +156,20 @@ class BatchRunner:
     """
 
     def __init__(self, fn: Callable, batch_size: int, donate: bool = False,
-                 prefetch: int = 2):
+                 prefetch: int = 2, mesh: Mesh | None = None,
+                 data_axis: str = "data"):
+        """``mesh``: when given, input batches are device_put *sharded* over
+        ``data_axis`` and the jitted program runs SPMD across all mesh
+        devices (the reference's partition-parallel inference, SURVEY.md
+        §2.4 row 2, with Spark executors → mesh devices). batch_size is
+        rounded up to a multiple of the axis size so shards stay equal."""
         self.batch_size = int(batch_size)
+        if mesh is not None:
+            n_shard = int(mesh.shape[data_axis])
+            self.batch_size = -(-self.batch_size // n_shard) * n_shard
+            self._sharding = data_sharding(mesh, data_axis)
+        else:
+            self._sharding = None
         self.prefetch = prefetch
         self._jitted = jax.jit(fn, donate_argnums=(0,) if donate else ())
 
@@ -170,7 +182,8 @@ class BatchRunner:
                 yield pad_batch(b, self.batch_size)
         # Prefetch only the device-bound leaves; n_valid stays host-side.
         arr_it, n_it = itertools.tee(staged())
-        dev_stream = prefetch_to_device((a for a, _ in arr_it), self.prefetch)
+        dev_stream = prefetch_to_device((a for a, _ in arr_it), self.prefetch,
+                                        sharding=self._sharding)
         for dev_batch, (_, n) in zip(dev_stream, n_it):
             out = self._jitted(dev_batch)
             out_np = jax.tree_util.tree_map(np.asarray, out)

@@ -58,28 +58,52 @@ class XlaImageTransformer(PicklesCallableParams, Transformer, HasInputCol,
     outputMode = Param(Params, "outputMode",
                        "output column content: 'vector' (list<float>) or "
                        "'image' (uint8 image struct)", TypeConverters.toString)
+    numDevices = Param(Params, "numDevices",
+                       "devices to shard inference batches over: 1 (default) "
+                       "single-device, -1 all visible — the reference's "
+                       "partition-parallel executors become mesh devices",
+                       TypeConverters.toInt)
 
     @keyword_only
     def __init__(self, inputCol=None, outputCol=None, fn=None, inputSize=None,
-                 batchSize=None, channelOrder=None, outputMode=None):
+                 batchSize=None, channelOrder=None, outputMode=None,
+                 numDevices=None):
         super().__init__()
         self._setDefault(batchSize=32, channelOrder="RGB", outputMode="vector",
-                         inputCol="image")
+                         inputCol="image", numDevices=1)
         self._set(**self._input_kwargs)
 
     @keyword_only
     def setParams(self, inputCol=None, outputCol=None, fn=None, inputSize=None,
-                  batchSize=None, channelOrder=None, outputMode=None):
+                  batchSize=None, channelOrder=None, outputMode=None,
+                  numDevices=None):
         return self._set(**self._input_kwargs)
 
     def _make_fn(self):
         """Hook for subclasses that derive fn from other params."""
         return self.getOrDefault(self.fn)
 
+    def _num_devices(self) -> int:
+        # subclasses with their own __init__ may never have set the default
+        return (self.getOrDefault(self.numDevices)
+                if self.isSet("numDevices") or self.hasDefault("numDevices")
+                else 1)
+
     def _runner_key(self) -> tuple:
         """Cache key for the compiled runner; subclasses add model identity."""
-        return (self.getBatchSize(),
+        return (self.getBatchSize(), self._num_devices(),
                 id(self._paramMap.get(self.fn)) if self.hasParam("fn") else 0)
+
+    def _mesh(self):
+        from ..core import runtime
+        n = self._num_devices()
+        if n == 1:
+            return None
+        devs = runtime.devices()
+        n = len(devs) if n == -1 else n
+        if n > len(devs):
+            raise ValueError(f"numDevices={n} but only {len(devs)} visible")
+        return runtime.make_mesh({"data": n}, devices_=devs[:n])
 
     def _get_runner(self) -> BatchRunner:
         """One BatchRunner (→ one XLA compilation) per param configuration.
@@ -91,7 +115,8 @@ class XlaImageTransformer(PicklesCallableParams, Transformer, HasInputCol,
         cached = getattr(self, "_runner_cache", None)
         if cached is not None and cached[0] == key:
             return cached[1]
-        runner = BatchRunner(self._make_fn(), self.getBatchSize())
+        runner = BatchRunner(self._make_fn(), self.getBatchSize(),
+                             mesh=self._mesh())
         self._runner_cache = (key, runner)
         return runner
 

@@ -240,3 +240,22 @@ def test_runner_cached_across_transform_calls():
     r1 = f._get_runner()
     f.transform(df).collect()
     assert f._get_runner() is r1
+
+
+def test_xla_image_transformer_multi_device_sharded():
+    """numDevices=-1 shards inference over the full mesh; results must be
+    identical to the single-device path (SURVEY.md §2.4 row 2)."""
+    df, _ = image_df(n=10, parts=2)
+    fn = lambda b: jnp.mean(b, axis=(1, 2))
+    single = sdl.XlaImageTransformer(inputCol="image", outputCol="f", fn=fn,
+                                     inputSize=(8, 8), batchSize=4)
+    multi = sdl.XlaImageTransformer(inputCol="image", outputCol="f", fn=fn,
+                                    inputSize=(8, 8), batchSize=4,
+                                    numDevices=-1)
+    a = np.stack([r.f for r in single.transform(df).collect()])
+    b = np.stack([r.f for r in multi.transform(df).collect()])
+    np.testing.assert_allclose(a, b, atol=1e-6)
+    with pytest.raises(ValueError, match="only"):
+        sdl.XlaImageTransformer(inputCol="image", outputCol="f", fn=fn,
+                                inputSize=(8, 8),
+                                numDevices=99).transform(df)
