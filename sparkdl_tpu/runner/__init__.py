@@ -5,6 +5,7 @@ the compiled step; process topology is SPMD-per-host, not mpirun-per-slot.
 """
 
 from .checkpoint import CheckpointManager, load_portable, save_portable
+from .failures import classify_exception, diagnose_context, is_retryable
 from .metrics import MetricsLogger, ThroughputMeter, debug_mode, trace
 from .train_state import (TrainState, bn_classifier_loss, make_eval_step,
                           make_shard_map_step, make_train_step,
@@ -21,5 +22,6 @@ __all__ = [
     "TrainState", "make_train_step", "make_shard_map_step", "make_eval_step",
     "state_sharding", "softmax_cross_entropy_loss", "bn_classifier_loss",
     "CheckpointManager", "save_portable", "load_portable",
+    "classify_exception", "is_retryable", "diagnose_context",
     "ThroughputMeter", "MetricsLogger", "trace", "debug_mode",
 ]

@@ -1,0 +1,104 @@
+"""Failure detection & classification (SURVEY.md §5.3).
+
+The reference's only failure story was Spark task retry + whole-job failure
+for Horovod runs. The TPU-native equivalent distinguishes *infrastructure*
+failures (backend unavailable, preempted chip, interconnect flake — worth a
+checkpoint-and-restart) from *program* failures (user code bugs, shape
+errors, NaNs — retrying burns the restart budget and re-raises anyway).
+
+``classify_exception`` is the policy point: ``run_with_restarts`` and
+``bench.py`` both route through it. ``diagnose_context`` wires the installed
+``cloud-tpu-diagnostics`` package (SURVEY.md §5.3 names it) so a faulting
+run leaves a stack-trace record on disk for postmortem.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import re
+
+log = logging.getLogger("sparkdl_tpu.runner")
+
+# gRPC/XLA status words that indicate the *platform* (not the program) broke.
+# UNAVAILABLE/ABORTED/CANCELLED: backend or coordination flake.
+# DEADLINE_EXCEEDED: rendezvous/collective timeout (peer died).
+# INTERNAL on "TPU"/"backend"/"compile" strings: PJRT plugin hiccup — the
+# axon relay surfaces transient setup errors as INTERNAL.
+_RETRYABLE_PATTERNS = re.compile(
+    r"(UNAVAILABLE|ABORTED|CANCELLED|DEADLINE_EXCEEDED"
+    r"|backend setup|failed to connect|connection (reset|refused)"
+    r"|socket closed|preempt|slice .* unhealthy|device or resource busy"
+    r"|coordination service|heartbeat)", re.IGNORECASE)
+
+# Definitely-program failures even if they arrive wrapped in a runtime error.
+_FATAL_PATTERNS = re.compile(
+    r"(INVALID_ARGUMENT|UNIMPLEMENTED|FAILED_PRECONDITION"
+    r"|NaN encountered|RESOURCE_EXHAUSTED)", re.IGNORECASE)
+
+_FATAL_TYPES = (TypeError, ValueError, KeyError, IndexError, AttributeError,
+                AssertionError, ZeroDivisionError, NotImplementedError)
+
+
+def classify_exception(exc: BaseException) -> str:
+    """Return ``"retryable"`` or ``"fatal"`` for a training-run exception.
+
+    Python-level errors (ValueError & co) are always fatal — they are the
+    user's bug, and HorovodRunner-era whole-job retries on those were pure
+    waste. Runtime/XLA errors are classified by status-code text: transport
+    and availability codes retry; argument/precondition codes do not.
+    Unknown runtime errors default to retryable — the checkpoint-resume path
+    makes a wasted restart cheap, while a missed restart loses the job.
+    """
+    if isinstance(exc, KeyboardInterrupt):
+        return "fatal"
+    if isinstance(exc, _FATAL_TYPES):
+        return "fatal"
+    msg = f"{type(exc).__name__}: {exc}"
+    if _FATAL_PATTERNS.search(msg):
+        return "fatal"
+    if _RETRYABLE_PATTERNS.search(msg):
+        return "retryable"
+    # XlaRuntimeError / RuntimeError with no recognized status: assume infra.
+    if type(exc).__name__ in ("XlaRuntimeError", "RuntimeError", "OSError",
+                              "ConnectionError", "TimeoutError"):
+        return "retryable"
+    return "fatal"
+
+
+def is_retryable(exc: BaseException) -> bool:
+    return classify_exception(exc) == "retryable"
+
+
+@contextlib.contextmanager
+def diagnose_context(interval_s: int = 10):
+    """Wrap a run in cloud-tpu-diagnostics stack-trace collection.
+
+    On a fault (or every ``interval_s`` seconds) inside the block, the
+    diagnostics package writes thread stack traces to its default dir
+    (``/tmp/debugging/``) for postmortem — the failure-*detection* half of
+    §5.3 that exception classification alone can't see (hangs, signals).
+    No-ops gracefully if the package is unavailable.
+
+    ``interval_s`` replaces the package's 600s default: its collection
+    thread sleeps a full interval and ``stop_debugging`` JOINS it, so
+    context exit would block up to the interval — 10s keeps periodic hang
+    evidence flowing without making every wrapped run 10 minutes longer.
+    """
+    try:
+        from cloud_tpu_diagnostics import diagnostic
+        from cloud_tpu_diagnostics.configuration import (
+            debug_configuration, diagnostic_configuration,
+            stack_trace_configuration)
+
+        stack_cfg = stack_trace_configuration.StackTraceConfig(
+            collect_stack_trace=True, stack_trace_to_cloud=False,
+            stack_trace_interval_seconds=interval_s)
+        cfg = diagnostic_configuration.DiagnosticConfig(
+            debug_config=debug_configuration.DebugConfig(
+                stack_trace_config=stack_cfg))
+        with diagnostic.diagnose(cfg):
+            yield
+    except ImportError:
+        log.debug("cloud-tpu-diagnostics unavailable; running without")
+        yield

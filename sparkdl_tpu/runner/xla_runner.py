@@ -254,20 +254,36 @@ class XlaRunner:
             _CURRENT_CONTEXT.pop()
 
     def run_with_restarts(self, main_fn: Callable, max_restarts: int = 2,
-                          backoff_s: float = 1.0, **kwargs) -> Any:
+                          backoff_s: float = 1.0, retry_all: bool = False,
+                          diagnose: bool = False, **kwargs) -> Any:
         """Checkpoint-and-restart supervision (SURVEY.md §5.3): re-invoke
         ``main_fn`` on failure; with a checkpoint_dir set, ``ctx.fit`` resumes
         from the last saved step, so a restart loses at most
         ``checkpoint_every`` steps — the reference's whole-job-retry story,
-        minus losing the whole job."""
+        minus losing the whole job.
+
+        Failures are classified (``failures.classify_exception``): only
+        infrastructure flakes (backend UNAVAILABLE, rendezvous timeouts,
+        preemption) restart; program errors (ValueError & co) re-raise
+        immediately — retrying the user's bug wastes the restart budget.
+        ``retry_all=True`` restores indiscriminate retry. ``diagnose=True``
+        wraps each attempt in cloud-tpu-diagnostics stack-trace collection.
+        """
+        from . import failures
+
         attempt = 0
         while True:
             try:
+                if diagnose:
+                    with failures.diagnose_context():
+                        return self.run(main_fn, **kwargs)
                 return self.run(main_fn, **kwargs)
-            except Exception:
+            except Exception as e:
+                kind = failures.classify_exception(e)
                 attempt += 1
-                if attempt > max_restarts:
+                if (kind == "fatal" and not retry_all) \
+                        or attempt > max_restarts:
                     raise
-                log.exception("run failed; restart %d/%d", attempt,
-                              max_restarts)
+                log.exception("run failed (%s); restart %d/%d", kind,
+                              attempt, max_restarts)
                 time.sleep(backoff_s * attempt)

@@ -124,3 +124,35 @@ def test_count_fast_path_does_not_materialize():
     chained = df.select("x").withColumnBatch("y", probe, inputCols=["x"])
     assert chained.count() == 6
     assert calls == []  # length-preserving chain → no materialization
+
+
+def test_streaming_only_for_row_wise_ops():
+    """iterBatches may slice raw partitions ahead of ROW-WISE ops, but a
+    withColumnBatch fn that aggregates across its batch (mean-centering)
+    must keep partition granularity — collect() and iterBatches() must
+    agree (code-review regression, round 2)."""
+    df = DataFrame.fromPydict({"x": [float(i) for i in range(16)]},
+                              numPartitions=1)
+    centered = df.withColumnBatch(
+        "z", lambda x: np.asarray(x) - np.asarray(x).mean(), ["x"])
+    via_collect = [r.z for r in centered.collect()]
+    via_batches = [z for b in centered.iterBatches(4)
+                   for z in b.column("z").to_pylist()]
+    assert via_collect == via_batches
+
+    # row-wise chain (withColumn + filter + select) IS streamed: chunks of
+    # at most the batch size reach the ops
+    seen = []
+    probe = df.withColumn("w", lambda x: x + 1, ["x"]) \
+              .filter(lambda r: r.x != 3.0)
+
+    def spy(b):
+        seen.append(b.num_rows)
+        return b
+
+    spy._changes_length = False
+    spy._row_wise = True
+    out = [r for b in probe.mapBatches(spy).iterBatches(4)
+           for r in b.to_pylist()]
+    assert len(out) == 15
+    assert max(seen) <= 4

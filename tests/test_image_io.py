@@ -117,6 +117,77 @@ def test_read_images_keep_failures(tmp_path):
     assert rows[1].image["height"] == 8
 
 
+def test_read_images_is_lazy(tmp_path):
+    """readImages must not decode on the driver at construction time:
+    decode runs per-chunk at materialization (round-1 verdict item 4)."""
+    from PIL import Image
+    for i in range(6):
+        Image.fromarray(rand_img(seed=i)).save(tmp_path / f"img_{i}.png")
+
+    calls = []
+
+    def counting_decode(data, origin):
+        calls.append(origin)
+        return imageIO.decodeImage(data, origin)
+
+    df = imageIO.readImagesWithCustomFn(str(tmp_path),
+                                        decode_fn=counting_decode)
+    assert calls == []  # nothing decoded yet
+    rows = df.collect()
+    assert len(rows) == 6
+    assert len(calls) == 6
+
+
+def test_read_images_streams_in_chunks(tmp_path):
+    """iterBatches over a lazy readImages frame decodes at batch granularity
+    — a single partition of N images never holds all N decoded at once."""
+    from PIL import Image
+    for i in range(10):
+        Image.fromarray(rand_img(seed=i)).save(tmp_path / f"img_{i}.png")
+
+    chunk_sizes = []
+
+    def counting_decode(data, origin):
+        counting_decode.pending += 1
+        return imageIO.decodeImage(data, origin)
+
+    counting_decode.pending = 0
+
+    df = imageIO.readImagesWithCustomFn(str(tmp_path),
+                                        decode_fn=counting_decode,
+                                        numPartitions=1)
+    for b in df.iterBatches(4):
+        chunk_sizes.append(counting_decode.pending)
+        counting_decode.pending = 0
+    # decode happened in ≤4-row chunks interleaved with batch delivery,
+    # not 10-at-once up front
+    assert max(chunk_sizes) <= 8  # one chunk + at most one prefetched chunk
+    assert sum(chunk_sizes) == 10
+
+
+def test_read_images_all_failed_raises(tmp_path):
+    """A directory of only-corrupt images must fail loudly at materialization
+    (the eager reader's guard, preserved by the lazy one)."""
+    for i in range(3):
+        (tmp_path / f"bad_{i}.png").write_bytes(b"broken")
+    df = imageIO.readImages(str(tmp_path))  # lazy: no error yet
+    with pytest.raises(ValueError, match="failed to decode"):
+        df.collect()
+
+
+def test_read_images_unreadable_file_raises_when_keeping_failures(tmp_path):
+    """dropImageFailures=False surfaces I/O errors (no silent placeholder)."""
+    from PIL import Image
+    Image.fromarray(rand_img()).save(tmp_path / "ok.png")
+    (tmp_path / "gone.png").symlink_to(tmp_path / "nonexistent.png")
+    df = imageIO.readImages(str(tmp_path), dropImageFailures=False)
+    with pytest.raises(OSError):
+        df.collect()
+    # and with dropping enabled the bad file is just skipped
+    rows = imageIO.readImages(str(tmp_path), dropImageFailures=True).collect()
+    assert len(rows) == 1
+
+
 def test_bgr_at_rest_convention():
     # decodeImage must store BGR (Spark/OpenCV at-rest layout): a pure-red
     # PNG decodes to a struct whose first byte-plane is blue==0, last is red.
