@@ -60,17 +60,52 @@ def shutdown():
 def allreduce(x, average: bool = True):
     """hvd.allreduce — for out-of-step reductions (metric aggregation).
 
-    Under a single controller every "rank" holds the same value already, so
-    the mean is the identity and the sum is ``x * size`` — no collective and
-    no compilation needed. In-step gradient reduction should NOT use this;
-    it is compiled into the train step (see train_state.py)."""
+    Single controller: every "rank" holds the same value already, so the
+    mean is the identity and the sum is ``x * size`` — no collective needed.
+    Multi-process SPMD: a REAL cross-process reduction runs (allgather over
+    the coordination backend, then reduce) — each process contributes its
+    own local value, exactly hvd.allreduce semantics. In-step gradient
+    reduction should NOT use this; it is compiled into the train step
+    (see train_state.py)."""
     ctx = _ctx()
+    if jax.process_count() > 1:
+        import numpy as np
+        from jax.experimental import multihost_utils
+        # Horovod's world = one rank per CHIP. A process speaks for all its
+        # local chips, so weight each contribution by local device count —
+        # sum/mean then agree with the single-controller x*size scaling
+        # whatever the process:device ratio is.
+        counts = np.asarray(multihost_utils.process_allgather(
+            np.asarray(jax.local_device_count(), np.int64)))  # [P]
+        vals = np.asarray(multihost_utils.process_allgather(
+            np.asarray(x)))  # [P, ...]
+        w = counts.astype(vals.dtype).reshape(
+            (-1,) + (1,) * (vals.ndim - 1))
+        total = (vals * w).sum(axis=0)
+        return jnp.asarray(total / counts.sum() if average else total)
     arr = jnp.asarray(x)
     return arr if average else arr * ctx.size
 
 
 def broadcast(x, root_rank: int = 0):
-    """hvd.broadcast — trivial under a single controller: the value is already
-    globally consistent; returns it replicated over the mesh."""
+    """hvd.broadcast — replicate rank-0's value everywhere.
+
+    Single controller: the value is already globally consistent; returned
+    replicated over the mesh. Multi-process: a real broadcast from process
+    ``root_rank`` (non-zero roots first rotate the value to process 0 via
+    allgather, since the underlying primitive is one-to-all from 0)."""
     ctx = _ctx()
+    if jax.process_count() > 1:
+        import numpy as np
+        from jax.experimental import multihost_utils
+        val = np.asarray(x)
+        if root_rank == 0:
+            root_val = multihost_utils.broadcast_one_to_all(val)
+        else:
+            # one collective: the allgather already hands every process the
+            # root's value
+            root_val = multihost_utils.process_allgather(val)[root_rank]
+        # same placement contract as the single-controller branch:
+        # replicated over the mesh
+        return ctx.put_replicated(np.asarray(root_val))
     return jax.device_put(jnp.asarray(x), ctx.replicated())

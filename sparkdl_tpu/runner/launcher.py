@@ -1,0 +1,122 @@
+"""Process launcher — the ``mpirun`` role of HorovodRunner (SURVEY.md §3.5).
+
+The reference acquired N Spark executor slots in barrier mode and ``mpirun``-ed
+a Python interpreter per slot; Horovod's MPI rendezvous then wired the ring.
+The TPU-native equivalent is *SPMD per host*: every host runs the SAME
+program, and ``jax.distributed`` (gRPC coordination service) provides the
+rendezvous that MPI did. This module supplies the missing piece — actually
+starting those N processes on one machine (tests, single-host multi-process)
+or printing the env recipe for real pods.
+
+Contract: ``launch(script, np=N)`` spawns N copies of ``python script`` with
+the coordination env set:
+
+- ``SPARKDL_COORDINATOR``   — host:port of process 0's coordination service
+- ``SPARKDL_NUM_PROCESSES`` — N
+- ``SPARKDL_PROCESS_ID``    — 0..N-1
+
+:class:`XlaRunner` auto-initializes ``jax.distributed`` from these (see
+``xla_runner._maybe_init_distributed``), so a worker script needs no launcher
+awareness beyond constructing ``XlaRunner(...)`` as usual. On a real pod,
+GKE/TPU-VM tooling sets the equivalent variables and no launcher is needed —
+this is for the reference's single-machine ``HorovodRunner(np=N)`` use case.
+
+CLI: ``python -m sparkdl_tpu.runner.launcher --np 2 train.py [args...]``
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+__all__ = ["launch", "free_port"]
+
+
+def free_port() -> int:
+    """An OS-assigned free TCP port for the coordination service."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch(script: str, np: int = 2, args: list[str] | None = None,
+           env: dict | None = None, timeout_s: float = 600.0,
+           coordinator: str | None = None,
+           capture: bool = False) -> list[subprocess.CompletedProcess]:
+    """Spawn ``np`` copies of ``python script`` wired for jax.distributed.
+
+    Blocks until all workers exit; raises ``RuntimeError`` naming the failed
+    ranks if any returncode is nonzero (after terminating stragglers, so a
+    dead rank can't leave the rest hung on a collective forever).
+
+    ``capture=True`` collects each worker's stdout/stderr into the returned
+    ``CompletedProcess``es (workers otherwise inherit this process's streams).
+    """
+    if np < 1:
+        raise ValueError(f"np must be >= 1, got {np}")
+    coordinator = coordinator or f"127.0.0.1:{free_port()}"
+    procs: list[subprocess.Popen] = []
+    for rank in range(np):
+        penv = dict(os.environ)
+        penv.update(env or {})
+        penv.update({
+            "SPARKDL_COORDINATOR": coordinator,
+            "SPARKDL_NUM_PROCESSES": str(np),
+            "SPARKDL_PROCESS_ID": str(rank),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, script] + list(args or []),
+            env=penv,
+            stdout=subprocess.PIPE if capture else None,
+            stderr=subprocess.PIPE if capture else None,
+            text=True))
+
+    deadline = time.monotonic() + timeout_s
+    results: list[subprocess.CompletedProcess | None] = [None] * np
+    try:
+        for rank, p in enumerate(procs):
+            remaining = max(1.0, deadline - time.monotonic())
+            out, err = p.communicate(timeout=remaining)
+            results[rank] = subprocess.CompletedProcess(
+                p.args, p.returncode, out, err)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        raise RuntimeError(
+            f"launch: workers did not finish within {timeout_s}s "
+            "(rendezvous hang? a dead peer blocks collectives)")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    failed = [r for r, res in enumerate(results) if res.returncode != 0]
+    if failed:
+        detail = ""
+        if capture:
+            r = results[failed[0]]
+            detail = "\n" + (r.stderr or r.stdout or "")[-2000:]
+        raise RuntimeError(f"launch: rank(s) {failed} exited nonzero{detail}")
+    return results  # type: ignore[return-value]
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="Launch N jax.distributed worker processes "
+                    "(HorovodRunner's mpirun role)")
+    ap.add_argument("--np", type=int, default=2)
+    ap.add_argument("--timeout", type=float, default=3600.0)
+    ap.add_argument("script")
+    ap.add_argument("args", nargs=argparse.REMAINDER)
+    ns = ap.parse_args(argv)
+    launch(ns.script, np=ns.np, args=ns.args, timeout_s=ns.timeout)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

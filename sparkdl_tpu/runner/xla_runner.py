@@ -22,6 +22,7 @@ DCN carries the cross-host legs of the collectives, ICI the intra-slice legs.
 from __future__ import annotations
 
 import logging
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable
@@ -39,6 +40,46 @@ from .train_state import (TrainState, make_eval_step, make_shard_map_step,
 log = logging.getLogger("sparkdl_tpu.runner")
 
 _CURRENT_CONTEXT: list["RunnerContext"] = []
+_DISTRIBUTED_INITIALIZED = False
+
+
+def _maybe_init_distributed(coordinator: str | None,
+                            num_processes: int | None,
+                            process_id: int | None) -> None:
+    """jax.distributed rendezvous — the mpirun/barrier-mode replacement.
+
+    Explicit args win; otherwise the ``SPARKDL_*`` env set by
+    ``runner.launcher`` is picked up, so worker scripts construct
+    ``XlaRunner`` identically on 1 or N processes. Idempotent.
+    """
+    global _DISTRIBUTED_INITIALIZED
+    if coordinator is None:
+        coordinator = os.environ.get("SPARKDL_COORDINATOR")
+        if coordinator:
+            num_processes = int(os.environ["SPARKDL_NUM_PROCESSES"])
+            process_id = int(os.environ["SPARKDL_PROCESS_ID"])
+    if coordinator is None or _DISTRIBUTED_INITIALIZED:
+        return
+    # The axon plugin registration pins config jax_platforms to "axon,cpu";
+    # honor an explicit JAX_PLATFORMS env the same way conftest does.
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+    # Cross-process CPU collectives need a real transport; gloo ships with
+    # jaxlib. Set it unconditionally (it only affects CPU client creation,
+    # harmless on TPU) — keying on the env var would miss runs where the
+    # platform merely RESOLVES to cpu, and probing the resolved backend here
+    # would initialize it before jax.distributed, which must come first.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # config name may move across jax versions
+        log.warning("could not select gloo CPU collectives")
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _DISTRIBUTED_INITIALIZED = True
+    log.info("jax.distributed initialized: process %d/%d via %s",
+             jax.process_index(), jax.process_count(), coordinator)
 
 
 @dataclass
@@ -76,10 +117,42 @@ class RunnerContext:
         return NamedSharding(self.mesh, P())
 
     def shard_batch(self, batch):
-        """Host numpy pytree → global array sharded over the data axis."""
+        """Host numpy pytree → global array sharded over the data axis.
+
+        Single-controller: ``batch`` is the GLOBAL batch, split across the
+        mesh by ``device_put``. Multi-process SPMD: each process passes its
+        LOCAL shard (HorovodRunner semantics — every rank loads its own
+        slice) and the global array is assembled across processes; the
+        leading dim must be equal on every process.
+        """
         sh = self.data_sharding()
-        return jax.tree_util.tree_map(
-            lambda x: jax.device_put(x, sh), batch)
+        if jax.process_count() == 1:
+            return jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, sh), batch)
+
+        def put(x):
+            x = np.asarray(x)
+            global_shape = (x.shape[0] * jax.process_count(),) + x.shape[1:]
+            return jax.make_array_from_process_local_data(
+                sh, x, global_shape=global_shape)
+
+        return jax.tree_util.tree_map(put, batch)
+
+    def put_replicated(self, tree):
+        """Host pytree → arrays replicated over the (global) mesh; works
+        under both single-controller and multi-process (where plain
+        ``device_put`` would reject non-addressable devices)."""
+        rep = self.replicated()
+        if jax.process_count() == 1:
+            return jax.tree_util.tree_map(
+                lambda x: jax.device_put(np.asarray(x), rep), tree)
+
+        def put(x):
+            x = np.asarray(x)
+            return jax.make_array_from_process_local_data(
+                rep, x, global_shape=x.shape)
+
+        return jax.tree_util.tree_map(put, tree)
 
     # -- compiled steps ---------------------------------------------------
     def make_train_step(self, loss_fn, explicit_collectives: bool = False,
@@ -133,9 +206,7 @@ class RunnerContext:
         # Replicate state over the mesh: fresh params arrive on one device
         # (and orbax restores there too); the sharded batch needs the state
         # addressable on every mesh device.
-        rep = self.replicated()
-        state = jax.tree_util.tree_map(
-            lambda x: jax.device_put(np.asarray(x), rep), state)
+        state = self.put_replicated(state)
 
         step_fn = self.make_train_step(
             loss_fn, explicit_collectives=explicit_collectives,
@@ -154,7 +225,11 @@ class RunnerContext:
                     batch = next(data_it)
                 except StopIteration:
                     break
-                n = len(jax.tree_util.tree_leaves(batch)[0])
+                # Multi-process: `data` yields LOCAL shards (shard_batch
+                # contract) — the global step consumed n * process_count
+                # examples, and per-chip rates divide by GLOBAL chip count.
+                n = len(jax.tree_util.tree_leaves(batch)[0]) \
+                    * self.num_processes
                 with metrics_lib.step_annotation(i):
                     state, m = step_fn(state, self.shard_batch(batch))
                 # Host sync only at metering/logging boundaries; otherwise
@@ -216,11 +291,9 @@ class XlaRunner:
                  coordinator: str | None = None,
                  num_processes: int | None = None,
                  process_id: int | None = None):
-        if coordinator is not None:
-            # Multi-host rendezvous — the mpirun/barrier-mode replacement.
-            jax.distributed.initialize(
-                coordinator_address=coordinator,
-                num_processes=num_processes, process_id=process_id)
+        # Multi-host rendezvous — explicit args or the launcher's SPARKDL_*
+        # env (no-op on a single process with neither).
+        _maybe_init_distributed(coordinator, num_processes, process_id)
         devs = jax.devices()
         n = len(devs) if np in (-1, None) else int(np)
         if n > len(devs):

@@ -1,0 +1,46 @@
+"""Multi-process XlaRunner proof (SURVEY.md §2.5/§3.5 hard-part #1;
+round-1 verdict item 2).
+
+Spawns 2 REAL OS processes via runner.launcher (the mpirun role), each with
+one local CPU device; jax.distributed + gloo provide rendezvous and the
+cross-process collective transport. The worker asserts gradient-allreduce
+equivalence against a single-device reference over the global batch —
+the same equivalence bar the in-process tests use.
+"""
+
+import os
+
+import pytest
+
+from sparkdl_tpu.runner import launcher
+
+_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "mp_worker.py")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_two_process_train_and_collectives(tmp_path):
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": _REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        # each worker gets exactly ONE local cpu device (the parent test
+        # env forces 8 — undo that so global mesh = 2 processes x 1)
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+    }
+    results = launcher.launch(_WORKER, np=2, args=[str(tmp_path)], env=env,
+                              timeout_s=420.0, capture=True)
+    assert (tmp_path / "rank0.ok").exists(), results[0].stderr[-2000:]
+    assert (tmp_path / "rank1.ok").exists(), results[1].stderr[-2000:]
+
+
+def test_launcher_propagates_failures(tmp_path):
+    bad = tmp_path / "boom.py"
+    bad.write_text("import sys; sys.exit(3)\n")
+    with pytest.raises(RuntimeError, match="rank"):
+        launcher.launch(str(bad), np=2, timeout_s=60.0, capture=True)
+
+
+def test_launcher_rejects_bad_np():
+    with pytest.raises(ValueError):
+        launcher.launch("x.py", np=0)
