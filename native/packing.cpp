@@ -66,19 +66,32 @@ ResizePlan make_plan(int in, int out) {
   return plan;
 }
 
+// Final-store conversion: float accumulator -> output sample type. The
+// uint8 specialization clamps and rounds (half away from zero, like PIL),
+// so a u8->u8 resize round-trips exactly for the identity affine.
+inline void store_sample(float v, float* out) { *out = v; }
+inline void store_sample(float v, uint8_t* out) {
+  *out = static_cast<uint8_t>(std::min(255.0f, std::max(0.0f, v)) + 0.5f);
+}
+
 // Resample + pack one image: src (h,w,c) uint8 -> dst (out_h,out_w,c)
-// float32, with channel permutation perm[c] and affine y = x*scale+offset.
-// `scratch` holds the horizontal-pass intermediate (h * out_w * c floats).
-void pack_one(const uint8_t* src, int h, int w, int c, float* dst, int out_h,
+// float32 OR uint8 (T), with channel permutation perm[c] and affine
+// y = x*scale+offset. `scratch` holds the horizontal-pass intermediate
+// (h * out_w * c floats). The uint8 output path exists so the host can
+// ship 1 byte/sample over the (latency+bandwidth-bound) host->HBM link and
+// let the on-device program do the f32 cast, fused into the first conv.
+template <typename T>
+void pack_one(const uint8_t* src, int h, int w, int c, T* dst, int out_h,
               int out_w, const int* perm, float scale, float offset,
               std::vector<float>& scratch) {
   if (h == out_h && w == out_w) {
     const int64_t n = static_cast<int64_t>(h) * w;
     for (int64_t i = 0; i < n; ++i) {
       const uint8_t* px = src + i * c;
-      float* out = dst + i * c;
+      T* out = dst + i * c;
       for (int ch = 0; ch < c; ++ch)
-        out[ch] = static_cast<float>(px[perm[ch]]) * scale + offset;
+        store_sample(static_cast<float>(px[perm[ch]]) * scale + offset,
+                     out + ch);
     }
     return;
   }
@@ -109,28 +122,21 @@ void pack_one(const uint8_t* src, int h, int w, int c, float* dst, int out_h,
     const float* wgt = py_plan.weight.data() + py_plan.offset[oy];
     const int y0 = py_plan.start[oy];
     const int cnt = py_plan.count[oy];
-    float* out_row = dst + oy * row_stride;
+    T* out_row = dst + oy * row_stride;
     for (int64_t j = 0; j < row_stride; ++j) {
       float acc = 0.0f;
       for (int k = 0; k < cnt; ++k)
         acc += wgt[k] * scratch[(y0 + k) * row_stride + j];
-      out_row[j] = acc * scale + offset;
+      store_sample(acc * scale + offset, out_row + j);
     }
   }
 }
 
-}  // namespace
-
-extern "C" {
-
-// Pack n variable-size images into out[n, out_h, out_w, c] (float32,
-// C-contiguous). srcs[i] points at image i's (heights[i], widths[i], c)
-// uint8 HWC data. flip_bgr!=0 swaps channels 0<->2 (BGR(A)->RGB(A)).
-// Returns 0 on success, nonzero on bad arguments.
-int sdl_pack_images(const uint8_t** srcs, const int32_t* heights,
-                    const int32_t* widths, int32_t n, int32_t c, float* out,
-                    int32_t out_h, int32_t out_w, int32_t flip_bgr,
-                    float scale, float offset, int32_t n_threads) {
+template <typename T>
+int pack_images_impl(const uint8_t** srcs, const int32_t* heights,
+                     const int32_t* widths, int32_t n, int32_t c, T* out,
+                     int32_t out_h, int32_t out_w, int32_t flip_bgr,
+                     float scale, float offset, int32_t n_threads) {
   if (n < 0 || c < 1 || c > 4 || out_h < 1 || out_w < 1) return 1;
   int perm[4] = {0, 1, 2, 3};
   if (flip_bgr && c >= 3) {
@@ -162,6 +168,34 @@ int sdl_pack_images(const uint8_t** srcs, const int32_t* heights,
   return 0;
 }
 
+}  // namespace
+
+extern "C" {
+
+// Pack n variable-size images into out[n, out_h, out_w, c] (float32,
+// C-contiguous). srcs[i] points at image i's (heights[i], widths[i], c)
+// uint8 HWC data. flip_bgr!=0 swaps channels 0<->2 (BGR(A)->RGB(A)).
+// Returns 0 on success, nonzero on bad arguments.
+int sdl_pack_images(const uint8_t** srcs, const int32_t* heights,
+                    const int32_t* widths, int32_t n, int32_t c, float* out,
+                    int32_t out_h, int32_t out_w, int32_t flip_bgr,
+                    float scale, float offset, int32_t n_threads) {
+  return pack_images_impl(srcs, heights, widths, n, c, out, out_h, out_w,
+                          flip_bgr, scale, offset, n_threads);
+}
+
+// uint8-output variant: same resize/flip, output stays 1 byte/sample so the
+// host->device transfer ships 4x fewer bytes (the affine is normally
+// identity here; it is applied pre-rounding if given).
+int sdl_pack_images_u8(const uint8_t** srcs, const int32_t* heights,
+                       const int32_t* widths, int32_t n, int32_t c,
+                       uint8_t* out, int32_t out_h, int32_t out_w,
+                       int32_t flip_bgr, float scale, float offset,
+                       int32_t n_threads) {
+  return pack_images_impl(srcs, heights, widths, n, c, out, out_h, out_w,
+                          flip_bgr, scale, offset, n_threads);
+}
+
 // Fast path: one contiguous uniform batch src[n, h, w, c] uint8 ->
 // out[n, out_h, out_w, c] float32.
 int sdl_pack_batch(const uint8_t* src, int32_t n, int32_t h, int32_t w,
@@ -178,6 +212,6 @@ int sdl_pack_batch(const uint8_t* src, int32_t n, int32_t h, int32_t w,
                          out_w, flip_bgr, scale, offset, n_threads);
 }
 
-int sdl_abi_version() { return 1; }
+int sdl_abi_version() { return 2; }
 
 }  // extern "C"

@@ -23,6 +23,7 @@ from __future__ import annotations
 import collections
 import itertools
 import math
+import queue as queue_mod
 import threading
 from typing import Any, Callable, Iterable, Iterator, Sequence
 
@@ -146,6 +147,41 @@ def prefetch_to_device(iterator: Iterable, size: int = 2,
         yield out
 
 
+def background_iter(iterator: Iterable, maxsize: int = 2) -> Iterator:
+    """Drive ``iterator`` in a daemon thread through a bounded queue.
+
+    Wraps host-side producers (image decode/pack) so their work overlaps
+    device compute instead of serializing with it: the worker thread stays
+    ``maxsize`` items ahead of the consumer. Exceptions re-raise at the
+    consumption point. If the consumer abandons the generator early the
+    daemon thread parks on a full queue until process exit — bounded by
+    ``maxsize`` buffered items, and the interpreter does not wait for it.
+    """
+    # Queue(0) would mean *unbounded* — clamp to preserve backpressure.
+    q: queue_mod.Queue = queue_mod.Queue(maxsize=max(1, maxsize))
+    sentinel = object()
+    failure: list[BaseException] = []
+
+    def work():
+        try:
+            for item in iterator:
+                q.put(item)
+        except BaseException as e:  # noqa: BLE001 — re-raised in consumer
+            failure.append(e)
+        finally:
+            q.put(sentinel)
+
+    threading.Thread(target=work, daemon=True,
+                     name="sparkdl-feed").start()
+    while True:
+        item = q.get()
+        if item is sentinel:
+            break
+        yield item
+    if failure:
+        raise failure[0]
+
+
 class BatchRunner:
     """Drives one jitted function over a stream of host batches.
 
@@ -153,16 +189,28 @@ class BatchRunner:
     batch, prefetches into HBM, runs the compiled program, and slices off pad
     rows. One XLA compilation per (fn, batch_size); the first call pays the
     compile (~20-40s on the axon TPU), subsequent calls are cached.
+
+    Execution is *pipelined*: up to ``prefetch`` executions stay in flight
+    with their device→host copies started asynchronously, so the fetch of
+    batch k overlaps compute on batch k+1. On a remote-attached chip (axon
+    tunnel: ~65ms per blocking round-trip, measured round 3) serializing
+    put→run→fetch per batch costs 2-3 round-trips per batch; the in-flight
+    window hides all but the last.
     """
 
     def __init__(self, fn: Callable, batch_size: int, donate: bool = False,
                  prefetch: int = 2, mesh: Mesh | None = None,
-                 data_axis: str = "data"):
+                 data_axis: str = "data", input_cast=None):
         """``mesh``: when given, input batches are device_put *sharded* over
         ``data_axis`` and the jitted program runs SPMD across all mesh
         devices (the reference's partition-parallel inference, SURVEY.md
         §2.4 row 2, with Spark executors → mesh devices). batch_size is
-        rounded up to a multiple of the axis size so shards stay equal."""
+        rounded up to a multiple of the axis size so shards stay equal.
+
+        ``input_cast``: a dtype (e.g. ``jnp.float32``): every input leaf is
+        cast to it *inside* the jitted program. Feed uint8 host batches and
+        the cast fuses into the first consumer op — 4x fewer bytes over the
+        host→HBM link than pre-cast float32 feeds."""
         self.batch_size = int(batch_size)
         if mesh is not None:
             n_shard = int(mesh.shape[data_axis])
@@ -171,6 +219,12 @@ class BatchRunner:
         else:
             self._sharding = None
         self.prefetch = prefetch
+        if input_cast is not None:
+            inner = fn
+
+            def fn(batch):  # noqa: F811 — deliberate wrap
+                return inner(jax.tree_util.tree_map(
+                    lambda x: x.astype(input_cast), batch))
         self._jitted = jax.jit(fn, donate_argnums=(0,) if donate else ())
 
     def run(self, batches: Iterable[np.ndarray | dict]) -> Iterator[np.ndarray]:
@@ -184,10 +238,24 @@ class BatchRunner:
         arr_it, n_it = itertools.tee(staged())
         dev_stream = prefetch_to_device((a for a, _ in arr_it), self.prefetch,
                                         sharding=self._sharding)
+
+        def fetch(item):
+            out, n = item
+            out_np = jax.tree_util.tree_map(np.asarray, out)
+            return jax.tree_util.tree_map(lambda x: x[:n], out_np)
+
+        window: collections.deque = collections.deque()
         for dev_batch, (_, n) in zip(dev_stream, n_it):
             out = self._jitted(dev_batch)
-            out_np = jax.tree_util.tree_map(np.asarray, out)
-            yield jax.tree_util.tree_map(lambda x: x[:n], out_np)
+            # Start the device→host copy now; block only when popped.
+            for leaf in jax.tree_util.tree_leaves(out):
+                if hasattr(leaf, "copy_to_host_async"):
+                    leaf.copy_to_host_async()
+            window.append((out, n))
+            if len(window) > self.prefetch:
+                yield fetch(window.popleft())
+        while window:
+            yield fetch(window.popleft())
 
 
 def run_batched(fn: Callable, batches: Iterable, batch_size: int,

@@ -171,6 +171,17 @@ def resizeImageBatchNHWC(batch: np.ndarray, height: int, width: int) -> np.ndarr
         batch, (n, height, width, c), method="bilinear"))
 
 
+def _narrowing_safe(img: np.ndarray, out_dtype) -> np.ndarray:
+    """Guard float pixels entering a uint8 batch: numpy's unsafe cast would
+    truncate-and-wrap silently (0.9→0, -1→255, 300→44); round+clip instead.
+    Requesting uint8 output for float-mode images is still lossy — callers
+    that must preserve float data should request dtype=float32."""
+    if (np.dtype(out_dtype) == np.uint8
+            and np.issubdtype(img.dtype, np.floating)):
+        return np.clip(np.round(img), 0, 255)
+    return img
+
+
 def structsToNHWC(structs: Sequence[dict], height: int | None = None,
                   width: int | None = None, dtype=np.float32,
                   channelOrder: str = "RGB") -> np.ndarray:
@@ -202,7 +213,7 @@ def structsToNHWC(structs: Sequence[dict], height: int | None = None,
         if s["height"] != h or s["width"] != w:
             s = resizeImage(s, h, w)
         arr = imageStructToArray(s)
-        out[i] = _swapRB(arr) if flip else arr
+        out[i] = _narrowing_safe(_swapRB(arr) if flip else arr, out.dtype)
     return out
 
 
@@ -248,7 +259,7 @@ def imageColumnToNHWC(column: pa.Array, height: int | None = None,
                       "nChannels": c, "mode": int(modes[i]),
                       "data": view.tobytes()}
             img = imageStructToArray(resizeImage(struct, h, w))
-        out[i] = _swapRB(img) if flip else img
+        out[i] = _narrowing_safe(_swapRB(img) if flip else img, out.dtype)
     return out
 
 
@@ -263,7 +274,7 @@ def _native_pack_or_none(buffers_fn, heights, widths, modes, c, h, w, flip,
     native float path by <1 level — native.py logs once when the library is
     unavailable.
     """
-    if (np.dtype(dtype) != np.float32
+    if (np.dtype(dtype) not in (np.dtype(np.float32), np.dtype(np.uint8))
             or os.environ.get("SPARKDL_TPU_NATIVE", "1") == "0"
             or not all(ocvTypeByMode(int(m)).dtype == "uint8"
                        for m in modes)):
@@ -272,7 +283,7 @@ def _native_pack_or_none(buffers_fn, heights, widths, modes, c, h, w, flip,
     if not native.available():
         return None
     return native.pack_images(buffers_fn(), heights, widths, c, h, w,
-                              flip_bgr=flip)
+                              flip_bgr=flip, dtype=dtype)
 
 
 def nhwcToStructs(batch: np.ndarray, origins: Sequence[str] | None = None,

@@ -27,60 +27,93 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 _SO_PATH = os.path.join(_NATIVE_DIR, "libsparkdl_native.so")
 
-_lock = threading.Lock()
+_lock = threading.RLock()  # reentrant: _load holds it while calling ensure_built
 _lib = None
 _build_failed = False
 
 
 def ensure_built() -> bool:
-    """Compile the .so if missing/stale. Returns availability."""
+    """Compile the .so if missing/stale. Returns availability.
+
+    Thread-safe: the build runs under ``_lock`` so concurrent first-use from
+    multiple threads cannot race two ``make`` processes, and success is only
+    reported after re-checking that the .so actually exists (make exiting 0
+    with no artifact — e.g. a stale Makefile target — must not be trusted)."""
     global _build_failed
     src = os.path.join(_NATIVE_DIR, "packing.cpp")
     if not os.path.exists(src):
         return os.path.exists(_SO_PATH)
-    if (os.path.exists(_SO_PATH)
-            and os.path.getmtime(_SO_PATH) >= os.path.getmtime(src)):
+
+    def fresh() -> bool:
+        return (os.path.exists(_SO_PATH)
+                and os.path.getmtime(_SO_PATH) >= os.path.getmtime(src))
+
+    if fresh():
         return True
-    if _build_failed:
-        return False
-    try:
-        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
-                       capture_output=True, timeout=120)
-        return True
-    except (subprocess.SubprocessError, OSError) as e:
-        _build_failed = True
-        # Loud once: the PIL fallback resizes through uint8, so resized
-        # batches differ (<1 level per value) from native-built hosts.
-        _log.warning(
-            "sparkdl_tpu native packer build failed (%s); using the "
-            "pure-python fallback — resized image batches will differ "
-            "slightly from native-enabled hosts", e)
-        return False
+    with _lock:
+        if fresh():          # another thread built it while we waited
+            return True
+        if _build_failed:
+            return False
+        try:
+            subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                           capture_output=True, timeout=120)
+            if not fresh():
+                raise OSError("make succeeded but produced no "
+                              f"{os.path.basename(_SO_PATH)}")
+            return True
+        except (subprocess.SubprocessError, OSError) as e:
+            _build_failed = True
+            # Loud once: the PIL fallback resizes through uint8, so resized
+            # batches differ (<1 level per value) from native-built hosts.
+            _log.warning(
+                "sparkdl_tpu native packer build failed (%s); using the "
+                "pure-python fallback — resized image batches will differ "
+                "slightly from native-enabled hosts", e)
+            return False
+
+
+_lib_failed = False  # loaded but unusable (ABI mismatch) — don't re-dlopen
 
 
 def _load():
-    global _lib
+    global _lib, _lib_failed
     with _lock:
         if _lib is not None:
             return _lib
+        if _lib_failed:
+            return None
         if not ensure_built():
             return None
         lib = ctypes.CDLL(_SO_PATH)
         lib.sdl_abi_version.restype = ctypes.c_int
-        if lib.sdl_abi_version() != 1:
+        if lib.sdl_abi_version() != 2:
+            # Cache the mismatch: without this every pack call would redo
+            # dlopen+probe on the hot path, silently, forever.
+            _lib_failed = True
+            _log.warning(
+                "libsparkdl_native.so has ABI %d (want 2) — prebuilt "
+                "library is stale; using the pure-python fallback",
+                lib.sdl_abi_version())
             return None
-        lib.sdl_pack_images.restype = ctypes.c_int
-        lib.sdl_pack_images.argtypes = [
+        _common = [
             ctypes.POINTER(ctypes.c_void_p),           # srcs
             ctypes.POINTER(ctypes.c_int32),            # heights
             ctypes.POINTER(ctypes.c_int32),            # widths
             ctypes.c_int32, ctypes.c_int32,            # n, c
-            ctypes.POINTER(ctypes.c_float),            # out
+        ]
+        _tail = [
             ctypes.c_int32, ctypes.c_int32,            # out_h, out_w
             ctypes.c_int32,                            # flip_bgr
             ctypes.c_float, ctypes.c_float,            # scale, offset
             ctypes.c_int32,                            # n_threads
         ]
+        lib.sdl_pack_images.restype = ctypes.c_int
+        lib.sdl_pack_images.argtypes = (
+            _common + [ctypes.POINTER(ctypes.c_float)] + _tail)
+        lib.sdl_pack_images_u8.restype = ctypes.c_int
+        lib.sdl_pack_images_u8.argtypes = (
+            _common + [ctypes.POINTER(ctypes.c_uint8)] + _tail)
         lib.sdl_pack_batch.restype = ctypes.c_int
         lib.sdl_pack_batch.argtypes = [
             ctypes.POINTER(ctypes.c_uint8),
@@ -100,14 +133,24 @@ def available() -> bool:
 def pack_images(buffers: Sequence, heights: Sequence[int],
                 widths: Sequence[int], channels: int, out_h: int, out_w: int,
                 flip_bgr: bool = True, scale: float = 1.0,
-                offset: float = 0.0, n_threads: int = 0) -> np.ndarray:
-    """Variable-size uint8 HWC image buffers → (N, out_h, out_w, C) float32.
+                offset: float = 0.0, n_threads: int = 0,
+                dtype=np.float32) -> np.ndarray:
+    """Variable-size uint8 HWC image buffers → (N, out_h, out_w, C) batch.
 
     ``buffers``: per-image bytes-like objects (Arrow binary buffers, bytes,
     or uint8 arrays) each holding heights[i]*widths[i]*channels bytes.
+
+    ``dtype``: float32 (default) or uint8. The uint8 output keeps the batch
+    at 1 byte/sample so ``jax.device_put`` ships 4x fewer bytes over the
+    host→HBM link; the on-device program casts to float (fused by XLA into
+    its first consumer). Resize math still runs in float either way.
     """
+    dtype = np.dtype(dtype)
+    if dtype not in (np.dtype(np.float32), np.dtype(np.uint8)):
+        raise TypeError(f"pack_images output dtype must be float32 or "
+                        f"uint8, got {dtype}")
     n = len(buffers)
-    out = np.empty((n, out_h, out_w, channels), dtype=np.float32)
+    out = np.empty((n, out_h, out_w, channels), dtype=dtype)
     if n == 0:
         return out
     for b in buffers:
@@ -131,10 +174,14 @@ def pack_images(buffers: Sequence, heights: Sequence[int],
         *[a.ctypes.data_as(ctypes.c_void_p) for a in arrays])
     hs = np.asarray(heights, dtype=np.int32)
     ws = np.asarray(widths, dtype=np.int32)
-    rc = lib.sdl_pack_images(
+    if dtype == np.uint8:
+        entry, ctype = lib.sdl_pack_images_u8, ctypes.c_uint8
+    else:
+        entry, ctype = lib.sdl_pack_images, ctypes.c_float
+    rc = entry(
         ptrs, hs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         ws.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-        n, channels, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        n, channels, out.ctypes.data_as(ctypes.POINTER(ctype)),
         out_h, out_w, int(flip_bgr), float(scale), float(offset), n_threads)
     if rc != 0:
         raise ValueError(f"sdl_pack_images failed with code {rc}")
@@ -182,5 +229,8 @@ def _pack_images_numpy(buffers, heights, widths, channels, out, flip_bgr,
                              dtype=np.uint8)
             if arr.ndim == 2:
                 arr = arr[:, :, None]
-        out[i] = arr.astype(np.float32) * scale + offset
+        vals = arr.astype(np.float32) * scale + offset
+        if out.dtype == np.uint8:
+            vals = np.clip(np.round(vals), 0, 255)
+        out[i] = vals
     return out

@@ -127,3 +127,63 @@ def test_pack_images_rejects_nonuint8_arrays():
     with pytest.raises(TypeError, match="uint8"):
         native.pack_images([np.ones((4, 4, 3), np.float32)], [4], [4],
                            3, 4, 4)
+
+
+def test_pack_images_u8_output_exact_and_rounds():
+    """dtype=uint8 output: exact passthrough when no resize; rounded (<=0.5
+    level) match of the float path when resizing — the u8 feed ships 4x
+    fewer bytes to the device (round-3 perf fix)."""
+    rng = np.random.RandomState(3)
+    img = rng.randint(0, 256, size=(20, 30, 3)).astype(np.uint8)
+    same = native.pack_images([img.tobytes()], [20], [30], 3, 20, 30,
+                              flip_bgr=True, dtype=np.uint8)
+    assert same.dtype == np.uint8
+    np.testing.assert_array_equal(same[0], img[:, :, ::-1])
+
+    f32 = native.pack_images([img.tobytes()], [20], [30], 3, 11, 17,
+                             flip_bgr=True)
+    u8 = native.pack_images([img.tobytes()], [20], [30], 3, 11, 17,
+                            flip_bgr=True, dtype=np.uint8)
+    assert np.abs(f32[0] - u8[0].astype(np.float32)).max() <= 0.5 + 1e-3
+
+
+def test_pack_images_rejects_bad_dtype():
+    with pytest.raises(TypeError):
+        native.pack_images([b"\x00" * 3], [1], [1], 3, 1, 1,
+                           dtype=np.float64)
+
+
+def test_ensure_built_thread_safe_single_make(monkeypatch, tmp_path):
+    """Concurrent first-use must run at most one build, and a make that
+    produces no .so must be reported as a failure (ADVICE r1 item 2)."""
+    import threading
+    import sparkdl_tpu.native as nat
+
+    calls = []
+    lock_probe = threading.Barrier(4, timeout=10)
+
+    def fake_run(*a, **kw):
+        calls.append(a)
+        class R:
+            returncode = 0
+        return R()
+
+    monkeypatch.setattr(nat, "_SO_PATH", str(tmp_path / "never_built.so"))
+    monkeypatch.setattr(nat, "_build_failed", False)
+    monkeypatch.setattr(nat.subprocess, "run", fake_run)
+
+    results = []
+
+    def worker():
+        lock_probe.wait()
+        results.append(nat.ensure_built())
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # make "succeeded" but produced no .so -> failure, and only ONE make ran
+    # (the rest short-circuited on _build_failed under the lock).
+    assert results == [False] * 4
+    assert len(calls) == 1

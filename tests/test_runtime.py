@@ -95,3 +95,34 @@ def test_compile_cache_counts():
     f(jnp.ones((2,)))
     f(jnp.ones((3,)))
     assert cache.misses == 2 and cache.hits == 1
+
+
+def test_batch_runner_input_cast_and_pipelining():
+    """uint8 host feed + in-graph cast must match a float32 feed, across a
+    stream long enough to exercise the in-flight window (round-3 perf fix:
+    fetch of batch k overlaps compute of batch k+1)."""
+    fn = lambda b: b.sum(axis=(1, 2, 3))
+    rng = np.random.RandomState(0)
+    batches = [rng.randint(0, 256, size=(4, 5, 5, 3)).astype(np.uint8)
+               for _ in range(7)]
+    cast_runner = runtime.BatchRunner(fn, batch_size=4, input_cast=jnp.float32)
+    plain_runner = runtime.BatchRunner(fn, batch_size=4)
+    got = list(cast_runner.run(iter(batches)))
+    want = list(plain_runner.run(b.astype(np.float32) for b in batches))
+    assert len(got) == 7
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w)
+
+
+def test_background_iter_order_and_error():
+    assert list(runtime.background_iter(iter(range(20)), maxsize=3)) \
+        == list(range(20))
+
+    def boom():
+        yield 1
+        raise RuntimeError("decode failed")
+
+    it = runtime.background_iter(boom(), maxsize=2)
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="decode failed"):
+        list(it)
