@@ -30,6 +30,7 @@ from .graph import (GraphFunction, IsolatedSession, TFInputGraph,
                     makeGraphUDF)
 from .ops import flash_attention
 from .image.imageIO import imageSchema, readImages, readImagesWithCustomFn
+from .models import load_pretrained
 from .transformers import (DeepImageFeaturizer, DeepImagePredictor,
                            KerasImageFileTransformer, KerasTransformer,
                            TFImageTransformer, TFTransformer,
@@ -47,6 +48,7 @@ __all__ = [
     "Transformer", "Estimator", "Model", "Evaluator",
     "Pipeline", "PipelineModel", "MLWritable", "load",
     "imageSchema", "readImages", "readImagesWithCustomFn",
+    "load_pretrained",
     "XlaImageTransformer", "TFImageTransformer",
     "DeepImageFeaturizer", "DeepImagePredictor",
     "KerasImageFileTransformer", "XlaTransformer", "TFTransformer",

@@ -153,33 +153,55 @@ def background_iter(iterator: Iterable, maxsize: int = 2) -> Iterator:
     Wraps host-side producers (image decode/pack) so their work overlaps
     device compute instead of serializing with it: the worker thread stays
     ``maxsize`` items ahead of the consumer. Exceptions re-raise at the
-    consumption point. If the consumer abandons the generator early the
-    daemon thread parks on a full queue until process exit — bounded by
-    ``maxsize`` buffered items, and the interpreter does not wait for it.
+    consumption point. Closing/abandoning the generator (including an error
+    raised by the consumer mid-stream) cancels the producer thread — it
+    stops at the next queue hand-off rather than parking forever on a full
+    queue with its buffered batches pinned.
     """
     # Queue(0) would mean *unbounded* — clamp to preserve backpressure.
     q: queue_mod.Queue = queue_mod.Queue(maxsize=max(1, maxsize))
     sentinel = object()
+    cancelled = threading.Event()
     failure: list[BaseException] = []
 
     def work():
         try:
             for item in iterator:
-                q.put(item)
+                # Bounded-wait put so a cancelled consumer can't strand us
+                # on a full queue.
+                while not cancelled.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue_mod.Full:
+                        continue
+                if cancelled.is_set():
+                    return
         except BaseException as e:  # noqa: BLE001 — re-raised in consumer
             failure.append(e)
         finally:
-            q.put(sentinel)
+            # The sentinel must actually arrive while the consumer lives —
+            # dropping it on a transiently-full queue would strand the
+            # consumer in q.get(). Same bounded-wait as the items.
+            while not cancelled.is_set():
+                try:
+                    q.put(sentinel, timeout=0.1)
+                    break
+                except queue_mod.Full:
+                    continue
 
     threading.Thread(target=work, daemon=True,
                      name="sparkdl-feed").start()
-    while True:
-        item = q.get()
-        if item is sentinel:
-            break
-        yield item
-    if failure:
-        raise failure[0]
+    try:
+        while True:
+            item = q.get()
+            if item is sentinel:
+                break
+            yield item
+        if failure:
+            raise failure[0]
+    finally:
+        cancelled.set()
 
 
 class BatchRunner:

@@ -60,13 +60,17 @@ class NamedImageModel:
     feature_dim: int
     num_classes: int = IMAGENET_CLASSES
 
-    def build(self, dtype=jnp.float32, num_classes: int | None = None):
+    def build(self, dtype=jnp.float32, num_classes: int | None = None,
+              **build_kwargs):
+        """``build_kwargs`` pass through to the flax factory (e.g.
+        ``stride_on_3x3=False`` for keras-v1 ResNet semantics when running
+        keras-applications weights — models/pretrained.py)."""
         return self.factory(num_classes=num_classes or self.num_classes,
-                            dtype=dtype)
+                            dtype=dtype, **build_kwargs)
 
     def init_params(self, seed: int = 0, dtype=jnp.float32,
-                    num_classes: int | None = None):
-        model = self.build(dtype, num_classes)
+                    num_classes: int | None = None, **build_kwargs):
+        model = self.build(dtype, num_classes, **build_kwargs)
         h, w = self.input_size
 
         # jit the init: un-jitted flax init executes op-by-op, which on the
@@ -81,10 +85,10 @@ class NamedImageModel:
 
     def apply_fn(self, dtype=jnp.float32, features_only: bool = False,
                  with_preprocess: bool = True,
-                 num_classes: int | None = None) -> Callable:
+                 num_classes: int | None = None, **build_kwargs) -> Callable:
         """Returns jittable ``fn(variables, batch)``; batch is NHWC float32
         in [0,255] when ``with_preprocess`` (the image-struct convention)."""
-        model = self.build(dtype, num_classes)
+        model = self.build(dtype, num_classes, **build_kwargs)
 
         def fn(variables, batch):
             x = self.preprocess(batch) if with_preprocess else batch

@@ -20,22 +20,30 @@ ModuleDef = Any
 
 
 class BottleneckBlock(nn.Module):
-    """1x1 → 3x3 → 1x1 bottleneck with projection shortcut on shape change."""
+    """1x1 → 3x3 → 1x1 bottleneck with projection shortcut on shape change.
+
+    ``stride_on_3x3=True`` (default) is the v1.5 variant (downsampling in
+    the 3x3, as torchvision); ``False`` is the original v1 / keras-
+    applications placement (stride on the first 1x1) — parameter shapes are
+    identical, only the conv semantics differ, so set False when loading
+    keras-trained weights (models/pretrained.py)."""
     filters: int
     strides: int
     dtype: Any = jnp.float32
+    stride_on_3x3: bool = True
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
         norm = partial(nn.BatchNorm, use_running_average=not train,
                        momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        s = (self.strides, self.strides)
+        s1, s2 = ((1, 1), s) if self.stride_on_3x3 else (s, (1, 1))
         residual = x
-        y = conv(self.filters, (1, 1), name="conv1")(x)
+        y = conv(self.filters, (1, 1), strides=s1, name="conv1")(x)
         y = norm(name="bn1")(y)
         y = nn.relu(y)
-        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides),
-                 name="conv2")(y)
+        y = conv(self.filters, (3, 3), strides=s2, name="conv2")(y)
         y = norm(name="bn2")(y)
         y = nn.relu(y)
         y = conv(self.filters * 4, (1, 1), name="conv3")(y)
@@ -82,6 +90,7 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     width: int = 64
     dtype: Any = jnp.float32
+    stride_on_3x3: bool = True  # v1.5; False = keras-applications v1
 
     @nn.compact
     def __call__(self, x, train: bool = False, features_only: bool = False):
@@ -95,8 +104,11 @@ class ResNet(nn.Module):
         for i, n_blocks in enumerate(self.stage_sizes):
             for j in range(n_blocks):
                 strides = 2 if i > 0 and j == 0 else 1
+                kw = ({"stride_on_3x3": self.stride_on_3x3}
+                      if self.block is BottleneckBlock else {})
                 x = self.block(self.width * 2 ** i, strides, dtype=self.dtype,
-                               name=f"stage{i + 1}_block{j + 1}")(x, train=train)
+                               name=f"stage{i + 1}_block{j + 1}",
+                               **kw)(x, train=train)
         x = jnp.mean(x, axis=(1, 2))  # global average pool → (N, C)
         x = x.astype(jnp.float32)
         if features_only:

@@ -31,9 +31,11 @@ class _NamedImageTransformer(XlaImageTransformer, HasSeed):
                       "named model from SUPPORTED_MODELS",
                       TypeConverters.toString)
     weightsPath = Param(Params, "weightsPath",
-                        "local msgpack/safetensors weights file; random "
-                        "seeded init when unset (zero-egress environment)",
-                        TypeConverters.toString)
+                        "local weights file: flax msgpack/safetensors, or a "
+                        "Keras-applications .h5/.hdf5 (name-mapped import; "
+                        "ResNets then run the keras v1 stride placement). "
+                        "Random seeded init when unset (zero-egress "
+                        "environment)", TypeConverters.toString)
 
     _features_only = True
 
@@ -49,14 +51,33 @@ class _NamedImageTransformer(XlaImageTransformer, HasSeed):
     def _model(self) -> model_registry.NamedImageModel:
         return model_registry.get_model(self.getModelName())
 
+    def _keras_semantics(self) -> bool:
+        """True when the installed weights come from a Keras-applications
+        ``.h5`` file, in which case ResNets must run the keras v1 stride
+        placement (models/pretrained.py) for the weights to be faithful."""
+        return (self.isDefined(self.weightsPath)
+                and self.getOrDefault(self.weightsPath)
+                        .endswith((".h5", ".hdf5")))
+
+    def _build_kwargs(self) -> dict:
+        if self._keras_semantics() \
+                and self.getModelName().startswith("ResNet"):
+            return {"stride_on_3x3": False}
+        return {}
+
     def _load_variables(self):
         # getattr: instances revived by MLWritable.load bypass __init__.
         if getattr(self, "_variables", None) is None:
             m = self._model()
-            variables = m.init_params(seed=self.getOrDefault(self.seed))
+            variables = m.init_params(seed=self.getOrDefault(self.seed),
+                                      **self._build_kwargs())
             if self.isDefined(self.weightsPath):
                 path = self.getOrDefault(self.weightsPath)
-                if path.endswith(".safetensors"):
+                if path.endswith((".h5", ".hdf5")):
+                    from ..models import pretrained
+                    variables = pretrained.load_pretrained(
+                        self.getModelName(), path, template=variables)
+                elif path.endswith(".safetensors"):
                     variables = model_registry.load_safetensors(variables, path)
                 else:
                     variables = model_registry.load_weights(variables, path)
@@ -71,7 +92,8 @@ class _NamedImageTransformer(XlaImageTransformer, HasSeed):
     def _make_fn(self):
         m = self._model()
         variables = self._load_variables()
-        apply = m.apply_fn(features_only=self._features_only)
+        apply = m.apply_fn(features_only=self._features_only,
+                           **self._build_kwargs())
         return lambda batch: apply(variables, batch)
 
     def _runner_key(self) -> tuple:

@@ -22,8 +22,7 @@ from ..core.frame import DataFrame, _length_preserving, _set_column
 from ..core.params import (HasBatchSize, HasInputCol, HasOutputCol, Param,
                            Params, TypeConverters, keyword_only)
 from ..core.pipeline import Transformer
-from ..core import runtime
-from ..core.runtime import BatchRunner
+from ..core.runtime import BatchRunner, background_iter
 from ..image import imageIO
 from .payloads import PicklesCallableParams
 
@@ -172,7 +171,7 @@ class XlaImageTransformer(PicklesCallableParams, Transformer, HasInputCol,
             feed_dtype = (np.uint8 if all(
                 imageIO.ocvTypeByMode(int(m)).dtype == "uint8"
                 for m in np.unique(modes)) else np.float32)
-            chunks = runtime.background_iter(
+            chunks = background_iter(
                 (imageIO.imageColumnToNHWC(
                     col.slice(i, batch_size), h, w, channelOrder=order,
                     dtype=feed_dtype)

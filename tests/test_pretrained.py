@@ -1,0 +1,389 @@
+"""Foreign-checkpoint import tests (SURVEY.md §7 hard-part #4).
+
+Checkpoints are generated locally with the installed ``transformers``
+(torch) and ``keras`` packages — real foreign layouts, zero egress — and
+imports are verified by FORWARD-PASS EQUIVALENCE against the originating
+implementation, not just shape checks.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from sparkdl_tpu.models import pretrained
+from sparkdl_tpu.models.pretrained import (CheckpointMismatch,
+                                           import_hf_bert, import_hf_llama,
+                                           load_pretrained,
+                                           merge_into_template,
+                                           read_keras_h5)
+
+
+def _torch_state_to_safetensors(model, path):
+    from safetensors.torch import save_file
+    state = {k: v.contiguous() for k, v in model.state_dict().items()}
+    save_file(state, str(path))
+
+
+# ---------------------------------------------------------------------------
+# HF Llama
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def torch_mod():
+    return pytest.importorskip("torch")
+
+
+def test_import_hf_llama_forward_equivalence(tmp_path, torch_mod):
+    torch = torch_mod
+    tr = pytest.importorskip("transformers")
+    from sparkdl_tpu.models.llama import LlamaConfig, LlamaModel
+
+    cfg = LlamaConfig.tiny()
+    hf_cfg = tr.LlamaConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads,
+        num_key_value_heads=cfg.num_kv_heads,
+        intermediate_size=cfg.intermediate_size,
+        rope_theta=cfg.rope_theta, rms_norm_eps=cfg.rms_norm_eps,
+        max_position_embeddings=64, attention_bias=False,
+        mlp_bias=False, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = tr.LlamaForCausalLM(hf_cfg).eval()
+    f = tmp_path / "llama_hf.safetensors"
+    _torch_state_to_safetensors(hf, f)
+
+    variables = import_hf_llama(str(f), cfg)
+
+    ids = np.array([[3, 14, 15, 92, 6], [2, 7, 1, 8, 2]], np.int32)
+    with torch.no_grad():
+        want = hf(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+    got = np.asarray(LlamaModel(cfg).apply(variables, ids))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_import_hf_llama_tied_embeddings_and_errors(torch_mod):
+    from sparkdl_tpu.models.llama import LlamaConfig
+    cfg = LlamaConfig.tiny()
+
+    def full_state():
+        rng = np.random.RandomState(0)  # deterministic per call
+        hs, hd = cfg.hidden_size, cfg.head_dim
+        s = {"model.embed_tokens.weight":
+             rng.randn(cfg.vocab_size, hs).astype(np.float32),
+             "model.norm.weight": np.ones(hs, np.float32)}
+        for i in range(cfg.num_layers):
+            p = f"model.layers.{i}."
+            s[p + "self_attn.q_proj.weight"] = rng.randn(
+                cfg.num_heads * hd, hs).astype(np.float32)
+            s[p + "self_attn.k_proj.weight"] = rng.randn(
+                cfg.num_kv_heads * hd, hs).astype(np.float32)
+            s[p + "self_attn.v_proj.weight"] = rng.randn(
+                cfg.num_kv_heads * hd, hs).astype(np.float32)
+            s[p + "self_attn.o_proj.weight"] = rng.randn(
+                hs, cfg.num_heads * hd).astype(np.float32)
+            s[p + "mlp.gate_proj.weight"] = rng.randn(
+                cfg.intermediate_size, hs).astype(np.float32)
+            s[p + "mlp.up_proj.weight"] = rng.randn(
+                cfg.intermediate_size, hs).astype(np.float32)
+            s[p + "mlp.down_proj.weight"] = rng.randn(
+                hs, cfg.intermediate_size).astype(np.float32)
+            s[p + "input_layernorm.weight"] = np.ones(hs, np.float32)
+            s[p + "post_attention_layernorm.weight"] = np.ones(
+                hs, np.float32)
+        return s
+
+    # tied embeddings: no lm_head.weight → embedding transpose
+    state = full_state()
+    v = import_hf_llama(state, cfg)
+    np.testing.assert_array_equal(
+        v["params"]["lm_head"]["kernel"],
+        full_state()["model.embed_tokens.weight"].T)
+
+    # missing key → clear error
+    state = full_state()
+    del state["model.layers.0.self_attn.q_proj.weight"]
+    with pytest.raises(CheckpointMismatch, match="missing"):
+        import_hf_llama(state, cfg)
+
+    # wrong shape → clear error
+    state = full_state()
+    state["model.layers.0.self_attn.q_proj.weight"] = np.zeros(
+        (7, 7), np.float32)
+    with pytest.raises(CheckpointMismatch, match="shape"):
+        import_hf_llama(state, cfg)
+
+    # extra keys → config mismatch error
+    state = full_state()
+    state["model.layers.9.self_attn.q_proj.weight"] = np.zeros(
+        (1,), np.float32)
+    with pytest.raises(CheckpointMismatch, match="unconsumed"):
+        import_hf_llama(state, cfg)
+
+
+def test_imported_llama_works_with_lora_template(torch_mod):
+    """Base HF weights + LoRA-enabled model: merge keeps the freshly-init
+    adapters and overlays everything else."""
+    from sparkdl_tpu.models.llama import LlamaConfig, LlamaModel
+    cfg = LlamaConfig.tiny(lora_rank=2)
+    base_cfg = LlamaConfig.tiny()
+    rng = np.random.RandomState(1)
+    hs, hd = base_cfg.hidden_size, base_cfg.head_dim
+    state = {"embed_tokens.weight":
+             rng.randn(base_cfg.vocab_size, hs).astype(np.float32),
+             "norm.weight": np.ones(hs, np.float32),
+             "lm_head.weight": rng.randn(
+                 base_cfg.vocab_size, hs).astype(np.float32)}
+    for i in range(base_cfg.num_layers):
+        p = f"layers.{i}."
+        state[p + "self_attn.q_proj.weight"] = rng.randn(
+            base_cfg.num_heads * hd, hs).astype(np.float32)
+        state[p + "self_attn.k_proj.weight"] = rng.randn(
+            base_cfg.num_kv_heads * hd, hs).astype(np.float32)
+        state[p + "self_attn.v_proj.weight"] = rng.randn(
+            base_cfg.num_kv_heads * hd, hs).astype(np.float32)
+        state[p + "self_attn.o_proj.weight"] = rng.randn(
+            hs, base_cfg.num_heads * hd).astype(np.float32)
+        state[p + "mlp.gate_proj.weight"] = rng.randn(
+            base_cfg.intermediate_size, hs).astype(np.float32)
+        state[p + "mlp.up_proj.weight"] = rng.randn(
+            base_cfg.intermediate_size, hs).astype(np.float32)
+        state[p + "mlp.down_proj.weight"] = rng.randn(
+            hs, base_cfg.intermediate_size).astype(np.float32)
+        state[p + "input_layernorm.weight"] = np.ones(hs, np.float32)
+        state[p + "post_attention_layernorm.weight"] = np.ones(
+            hs, np.float32)
+
+    imported = import_hf_llama(state, base_cfg)
+    model = LlamaModel(cfg)
+    template = model.init(jax.random.PRNGKey(0),
+                          np.zeros((1, 4), np.int32))
+    merged = merge_into_template(imported, template)
+    # adapters exist and lora_b is zero-init → forward == base forward
+    q = merged["params"]["layer_0"]["attn"]["q_proj"]
+    assert "lora_a" in q and "lora_b" in q
+    ids = np.array([[1, 2, 3, 4]], np.int32)
+    from sparkdl_tpu.models.llama import LlamaModel as LM
+    base_logits = LM(base_cfg).apply(imported, ids)
+    lora_logits = model.apply(merged, ids)
+    np.testing.assert_allclose(np.asarray(lora_logits),
+                               np.asarray(base_logits), rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# HF BERT
+# ---------------------------------------------------------------------------
+
+def test_import_hf_bert_forward_equivalence(tmp_path, torch_mod):
+    torch = torch_mod
+    tr = pytest.importorskip("transformers")
+    from sparkdl_tpu.models.bert import (BertConfig,
+                                         BertForSequenceClassification)
+
+    cfg = BertConfig.tiny()
+    hf_cfg = tr.BertConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads,
+        intermediate_size=cfg.intermediate_size,
+        max_position_embeddings=cfg.max_position_embeddings,
+        type_vocab_size=cfg.type_vocab_size,
+        layer_norm_eps=cfg.layer_norm_eps, num_labels=3,
+        hidden_act="gelu")
+    torch.manual_seed(0)
+    hf = tr.BertForSequenceClassification(hf_cfg).eval()
+    f = tmp_path / "bert_hf.safetensors"
+    _torch_state_to_safetensors(hf, f)
+
+    variables = import_hf_bert(str(f), cfg, num_classes=3)
+
+    ids = np.array([[2, 45, 99, 31, 0, 0], [7, 1, 22, 90, 41, 3]], np.int32)
+    mask = np.array([[1, 1, 1, 1, 0, 0], [1, 1, 1, 1, 1, 1]], np.int32)
+    with torch.no_grad():
+        want = hf(torch.tensor(ids, dtype=torch.long),
+                  attention_mask=torch.tensor(mask)).logits.numpy()
+    model = BertForSequenceClassification(cfg, num_classes=3)
+    got = np.asarray(model.apply(variables, ids, mask))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_import_hf_bert_encoder_only_and_missing_classifier(torch_mod):
+    torch = torch_mod
+    tr = pytest.importorskip("transformers")
+    from sparkdl_tpu.models.bert import BertConfig, BertEncoder
+
+    cfg = BertConfig.tiny()
+    hf_cfg = tr.BertConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads,
+        intermediate_size=cfg.intermediate_size,
+        max_position_embeddings=cfg.max_position_embeddings,
+        type_vocab_size=cfg.type_vocab_size,
+        layer_norm_eps=cfg.layer_norm_eps)
+    torch.manual_seed(1)
+    hf = tr.BertModel(hf_cfg).eval()
+    state = {k: v.numpy() for k, v in hf.state_dict().items()}
+
+    variables = import_hf_bert(state, cfg)  # bare-encoder keys (no "bert.")
+    ids = np.array([[5, 9, 17, 2]], np.int32)
+    with torch.no_grad():
+        out = hf(torch.tensor(ids, dtype=torch.long))
+    seq, pooled = BertEncoder(cfg).apply(variables, ids)
+    np.testing.assert_allclose(np.asarray(seq),
+                               out.last_hidden_state.numpy(),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(pooled),
+                               out.pooler_output.numpy(),
+                               rtol=2e-4, atol=2e-4)
+
+    # classification import from an encoder-only checkpoint: zero head
+    v2 = import_hf_bert(state, cfg, num_classes=4)
+    assert v2["params"]["classifier"]["kernel"].shape == (cfg.hidden_size, 4)
+    np.testing.assert_array_equal(v2["params"]["classifier"]["kernel"], 0.0)
+
+
+# ---------------------------------------------------------------------------
+# Keras .h5
+# ---------------------------------------------------------------------------
+
+def _keras():
+    keras = pytest.importorskip("keras")
+    if keras.backend.backend() != "jax":
+        pytest.skip("keras not on jax backend")
+    return keras
+
+
+@pytest.mark.slow
+def test_import_keras_resnet50_forward_equivalence(tmp_path):
+    keras = _keras()
+    from sparkdl_tpu.models import resnet
+
+    km = keras.applications.ResNet50(weights=None,
+                                     classifier_activation=None)
+    f = str(tmp_path / "r50.h5")
+    km.save(f)  # legacy whole-model HDF5: real layer names survive
+
+    template = jax.tree_util.tree_map(
+        lambda s: np.zeros(s.shape, s.dtype),
+        jax.eval_shape(lambda: resnet.ResNet50(num_classes=1000).init(
+            jax.random.PRNGKey(0), np.zeros((1, 224, 224, 3), np.float32),
+            train=False)))
+    variables = load_pretrained("ResNet50", f, template=template)
+
+    x = np.random.RandomState(0).uniform(
+        -2, 2, (2, 224, 224, 3)).astype(np.float32)
+    want = np.asarray(km(x, training=False))
+    # keras-applications ResNet is v1: stride on the first 1x1
+    mine = resnet.ResNet50(num_classes=1000, stride_on_3x3=False)
+    got = np.asarray(mine.apply(variables, x, train=False))
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.slow
+def test_import_keras_inceptionv3_forward_equivalence(tmp_path):
+    keras = _keras()
+    from sparkdl_tpu.models import inception
+
+    km = keras.applications.InceptionV3(weights=None,
+                                        classifier_activation=None)
+    f = str(tmp_path / "iv3.h5")
+    km.save(f)
+
+    template = jax.tree_util.tree_map(
+        lambda s: np.zeros(s.shape, s.dtype),
+        jax.eval_shape(lambda: inception.InceptionV3(num_classes=1000).init(
+            jax.random.PRNGKey(0), np.zeros((1, 299, 299, 3), np.float32),
+            train=False)))
+    variables = load_pretrained("InceptionV3", f, template=template)
+
+    x = np.random.RandomState(1).uniform(
+        -1, 1, (1, 299, 299, 3)).astype(np.float32)
+    want = np.asarray(km(x, training=False))
+    got = np.asarray(inception.InceptionV3(num_classes=1000).apply(
+        variables, x, train=False))
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_read_keras_h5_legacy_format_and_vgg_mapping(tmp_path):
+    """Hand-built legacy-topological .h5 (the published keras-applications
+    layout, ':0'-suffixed weight names included) → name-mapped VGG import."""
+    import h5py
+    rng = np.random.RandomState(0)
+    tensors = {
+        "block1_conv1": [rng.randn(3, 3, 3, 8).astype(np.float32),
+                         rng.randn(8).astype(np.float32)],
+        "fc1": [rng.randn(32, 16).astype(np.float32),
+                rng.randn(16).astype(np.float32)],
+        "predictions": [rng.randn(16, 4).astype(np.float32),
+                        rng.randn(4).astype(np.float32)],
+    }
+    f = str(tmp_path / "legacy_vgg.h5")
+    with h5py.File(f, "w") as h:
+        h.attrs["layer_names"] = np.array(
+            [k.encode() for k in tensors] + [b"flatten"])
+        h.create_group("flatten").attrs["weight_names"] = np.array([])
+        for name, (kernel, bias) in tensors.items():
+            g = h.create_group(name)
+            g.attrs["weight_names"] = np.array(
+                [f"{name}/kernel:0".encode(), f"{name}/bias:0".encode()])
+            g.create_dataset(f"{name}/kernel:0", data=kernel)
+            g.create_dataset(f"{name}/bias:0", data=bias)
+
+    layers = read_keras_h5(f)
+    assert set(layers) == set(tensors)
+    np.testing.assert_array_equal(layers["fc1"][1], tensors["fc1"][1])
+
+    template = {"params": {
+        "block1_conv1": {"kernel": np.zeros((3, 3, 3, 8), np.float32),
+                         "bias": np.zeros(8, np.float32)},
+        "fc1": {"kernel": np.zeros((32, 16), np.float32),
+                "bias": np.zeros(16, np.float32)},
+        "head": {"kernel": np.zeros((16, 4), np.float32),
+                 "bias": np.zeros(4, np.float32)},
+    }}
+    out = pretrained.import_keras_vgg(f, template)
+    np.testing.assert_array_equal(out["params"]["head"]["kernel"],
+                                  tensors["predictions"][0])
+
+    # shape mismatch → clear error
+    template["params"]["fc1"]["kernel"] = np.zeros((9, 9), np.float32)
+    with pytest.raises(CheckpointMismatch):
+        pretrained.import_keras_vgg(f, template)
+
+
+@pytest.mark.slow
+def test_featurizer_with_keras_h5_weights(tmp_path):
+    """End-to-end BASELINE config-1 shape: DeepImageFeaturizer(weightsPath=
+    keras .h5) runs the imported weights with keras-v1 semantics and matches
+    the originating keras model's bottleneck features."""
+    keras = _keras()
+    import pyarrow as pa
+    import sparkdl_tpu as sdl
+    from sparkdl_tpu.image import imageIO
+
+    km = keras.applications.ResNet50(weights=None)
+    f = str(tmp_path / "r50.h5")
+    km.save(f)
+    feat_keras = keras.Model(km.input, km.layers[-2].output)  # avg_pool
+
+    rng = np.random.RandomState(0)
+    imgs = [rng.randint(0, 256, (224, 224, 3)).astype(np.uint8)
+            for _ in range(3)]  # RGB
+    # structs store BGR at rest (OpenCV convention) — flip before storing
+    structs = [imageIO.imageArrayToStruct(im[:, :, ::-1]) for im in imgs]
+    df = sdl.DataFrame.fromArrow(
+        pa.table({"image": pa.array(structs, type=imageIO.imageSchema)}))
+
+    feat = sdl.DeepImageFeaturizer(inputCol="image", outputCol="features",
+                                   modelName="ResNet50", batchSize=4,
+                                   weightsPath=f)
+    got = np.stack([np.asarray(r.features, np.float32)
+                    for r in feat.transform(df).collect()])
+
+    from sparkdl_tpu.models.registry import preprocess_caffe
+    x = np.stack([im.astype(np.float32) for im in imgs])
+    want = np.asarray(feat_keras(np.asarray(preprocess_caffe(x)),
+                                 training=False))
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)

@@ -126,3 +126,28 @@ def test_background_iter_order_and_error():
     assert next(it) == 1
     with pytest.raises(RuntimeError, match="decode failed"):
         list(it)
+
+
+def test_background_iter_cancellation_releases_producer():
+    """Abandoning the generator (consumer error path) must unblock the
+    producer thread rather than leaving it parked on a full queue forever
+    (code-review r3)."""
+    import threading
+    import time
+
+    produced = []
+
+    def gen():
+        for i in range(100):
+            produced.append(i)
+            yield i
+
+    before = threading.active_count()
+    it = runtime.background_iter(gen(), maxsize=1)
+    assert next(it) == 0
+    it.close()  # abandon mid-stream
+    deadline = time.time() + 5.0
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before, "producer thread leaked"
+    assert len(produced) < 100, "producer ran unbounded after close"
