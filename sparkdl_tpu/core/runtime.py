@@ -54,12 +54,22 @@ def platform() -> str:
 
 def make_mesh(axes: dict[str, int] | None = None,
               devices_: Sequence | None = None) -> Mesh:
-    """Build a named-axis device mesh.
+    """Build a named-axis device mesh, topology-aware on real hardware.
 
     ``axes`` maps axis name → size, e.g. ``{"data": 8}`` or
     ``{"data": 4, "model": 2}``. A size of ``-1`` means "whatever is left".
     Default: one ``data`` axis over all devices (pure DP — the reference's
     only training parallelism, SURVEY.md §2.4).
+
+    On a multi-chip TPU slice the device order is assigned by
+    ``jax.experimental.mesh_utils.create_device_mesh``, which lays mesh
+    axes along the ICI torus so the *innermost (last) axis rides
+    nearest-neighbor links* — put the most bandwidth-hungry axis last
+    (e.g. ``{"data": D, "model": T}`` for Megatron-style TP, or a pure
+    ``{"data": N}`` DP mesh whose allreduce then stays on-torus). This is
+    the "Spark executor placement becomes chip-topology aware" piece of
+    the BASELINE north star. Virtual/CPU device sets (tests, the driver
+    dryrun) fall back to a plain reshape.
     """
     devs = list(devices_ if devices_ is not None else jax.devices())
     if axes is None:
@@ -77,8 +87,28 @@ def make_mesh(axes: dict[str, int] | None = None,
         raise ValueError(
             f"Mesh axes {dict(zip(names, sizes))} need {total} devices, "
             f"have {len(devs)}")
-    arr = np.array(devs).reshape(sizes)
+    arr = _device_grid(devs, sizes)
     return Mesh(arr, axis_names=tuple(names))
+
+
+def _device_grid(devs: list, sizes: list[int]) -> np.ndarray:
+    """Arrange ``devs`` into a ``sizes``-shaped grid.
+
+    Real multi-chip TPU → ``mesh_utils.create_device_mesh`` (ICI-torus-
+    aware axis assignment). Single device, CPU, or anything mesh_utils
+    can't place (virtual topologies) → row-major reshape, which is exactly
+    what the torus-aware path degenerates to there anyway."""
+    if len(devs) > 1 and getattr(devs[0], "platform", "") == "tpu":
+        try:
+            from jax.experimental import mesh_utils
+            return mesh_utils.create_device_mesh(sizes, devices=devs)
+        except (ValueError, AssertionError, NotImplementedError) as e:
+            import logging
+            logging.getLogger(__name__).warning(
+                "mesh_utils.create_device_mesh failed (%s); falling back "
+                "to row-major device order — collectives may cross "
+                "non-adjacent ICI links", e)
+    return np.array(devs).reshape(sizes)
 
 
 def data_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:

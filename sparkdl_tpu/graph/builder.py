@@ -192,6 +192,27 @@ class IsolatedSession:
                 raise ValueError(f"Input {n.name!r} is not a placeholder")
         in_names = [n.name for n in inputs]
         out_nodes = list(outputs)
+        # Export-time validation: every placeholder reachable from the
+        # outputs must be declared an input — otherwise the omission only
+        # surfaces as "No feed provided" when the exported function is
+        # CALLED, far from the mistake (ADVICE r1 item 4).
+        declared = set(in_names)
+        reachable: dict[str, GraphNode] = {}
+        stack = list(out_nodes)
+        seen: set[str] = set()
+        while stack:
+            node = stack.pop()
+            if node.name in seen:
+                continue
+            seen.add(node.name)
+            if node.fn is None:
+                reachable[node.name] = node
+            stack.extend(node.deps)
+        missing = sorted(set(reachable) - declared)
+        if missing:
+            raise ValueError(
+                f"asGraphFunction: outputs depend on placeholder(s) "
+                f"{missing} not declared in inputs {sorted(declared)}")
 
         def fn(feeds: dict) -> dict:
             cache: dict = {}

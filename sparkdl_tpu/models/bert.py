@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Any
+from typing import Any, Callable, Optional
 
 import flax.linen as nn
 import jax
@@ -51,9 +51,16 @@ class BertConfig:
 class BertSelfAttention(nn.Module):
     cfg: BertConfig
     dtype: Any = jnp.float32
+    # (q, k, v, causal=..., kv_mask=...) → o. "auto" (default) resolves to
+    # the Pallas flash kernel on TPU and in-model dense attention elsewhere
+    # (ops.resolve_attn_fn); the S·S score matrix then never materializes
+    # and the padding mask rides as kv_mask. NB: attention-prob dropout is
+    # skipped under attn_fn (streaming softmax has no prob matrix to drop —
+    # the standard flash trade-off); hidden dropout elsewhere is unaffected.
+    attn_fn: Any = "auto"
 
     @nn.compact
-    def __call__(self, x, bias, deterministic: bool):
+    def __call__(self, x, bias, deterministic: bool, mask=None):
         c, d = self.cfg, self.dtype
         head_dim = c.hidden_size // c.num_heads
         dense = lambda name: nn.Dense(c.hidden_size, dtype=d, name=name)
@@ -63,11 +70,38 @@ class BertSelfAttention(nn.Module):
         q = split(dense("query")(x))
         k = split(dense("key")(x))
         v = split(dense("value")(x))
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(head_dim)
-        s = s.astype(jnp.float32) + bias  # mask as additive bias, f32 softmax
-        p = jax.nn.softmax(s, axis=-1).astype(d)
-        p = nn.Dropout(c.dropout_rate)(p, deterministic=deterministic)
-        o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+        from ..ops.flash_attention import resolve_attn_fn
+        attn_fn = resolve_attn_fn(self.attn_fn)
+        # The attn_fn path needs the [B, S] mask (padding can't ride the
+        # additive bias through a streaming softmax). Direct 3-arg callers
+        # (x, bias, deterministic) that never pass ``mask`` therefore keep
+        # the dense path — bias is NEVER silently dropped.
+        if attn_fn is not None and mask is None:
+            # no padding declared: plain (q, k, v, causal=...) contract —
+            # ring/Ulysses/dense drop in unchanged
+            o = attn_fn(q, k, v, causal=False)
+        elif attn_fn is not None:
+            import inspect
+            try:
+                params = inspect.signature(attn_fn).parameters
+                accepts_mask = ("kv_mask" in params or any(
+                    p.kind is inspect.Parameter.VAR_KEYWORD
+                    for p in params.values()))
+            except (TypeError, ValueError):
+                accepts_mask = True
+            if not accepts_mask:
+                raise TypeError(
+                    f"BertSelfAttention.attn_fn {attn_fn} does not accept "
+                    f"kv_mask — padded encoder batches need a mask-capable "
+                    f"attention (e.g. ops.flash_attention); for unpadded "
+                    f"batches call without an attention_mask")
+            o = attn_fn(q, k, v, causal=False, kv_mask=mask)
+        else:
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(head_dim)
+            s = s.astype(jnp.float32) + bias  # mask as bias, f32 softmax
+            p = jax.nn.softmax(s, axis=-1).astype(d)
+            p = nn.Dropout(c.dropout_rate)(p, deterministic=deterministic)
+            o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
         o = o.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[1],
                                             c.hidden_size)
         return nn.Dense(c.hidden_size, dtype=d, name="attention_output")(o)
@@ -76,11 +110,13 @@ class BertSelfAttention(nn.Module):
 class BertLayer(nn.Module):
     cfg: BertConfig
     dtype: Any = jnp.float32
+    attn_fn: Any = "auto"
 
     @nn.compact
-    def __call__(self, x, bias, deterministic: bool):
+    def __call__(self, x, bias, deterministic: bool, mask=None):
         c, d = self.cfg, self.dtype
-        a = BertSelfAttention(c, d, name="attention")(x, bias, deterministic)
+        a = BertSelfAttention(c, d, self.attn_fn, name="attention")(
+            x, bias, deterministic, mask)
         a = nn.Dropout(c.dropout_rate)(a, deterministic=deterministic)
         x = nn.LayerNorm(epsilon=c.layer_norm_eps, dtype=jnp.float32,
                          name="attention_norm")(x + a)
@@ -93,15 +129,24 @@ class BertLayer(nn.Module):
 
 
 class BertEncoder(nn.Module):
-    """Token ids (+mask, +segments) → (sequence_output, pooled_output)."""
+    """Token ids (+mask, +segments) → (sequence_output, pooled_output).
+
+    ``attn_fn``: pluggable attention (see BertSelfAttention) — pass
+    ``ops.flash_attention`` (or ``ops.auto_attn_fn()``) for the Pallas
+    kernel on TPU; padding masks ride through as ``kv_mask``."""
     cfg: BertConfig
     dtype: Any = jnp.float32
+    attn_fn: Any = "auto"
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
                  deterministic: bool = True):
         c, d = self.cfg, self.dtype
         B, S = input_ids.shape
+        # Track None-ness: an absent mask means "no padding", which lets a
+        # mask-less attn_fn (ring/Ulysses) run; a ones-mask would force the
+        # kv_mask contract for nothing.
+        user_mask = attention_mask
         if attention_mask is None:
             attention_mask = jnp.ones((B, S), jnp.int32)
         if token_type_ids is None:
@@ -122,7 +167,8 @@ class BertEncoder(nn.Module):
         bias = (1.0 - attention_mask[:, None, None, :].astype(jnp.float32)) \
             * -1e30
         for i in range(c.num_layers):
-            x = BertLayer(c, d, name=f"layer_{i}")(x, bias, deterministic)
+            x = BertLayer(c, d, self.attn_fn, name=f"layer_{i}")(
+                x, bias, deterministic, user_mask)
 
         pooled = nn.tanh(nn.Dense(c.hidden_size, dtype=d,
                                   name="pooler")(x[:, 0]))
@@ -134,11 +180,13 @@ class BertForSequenceClassification(nn.Module):
     cfg: BertConfig
     num_classes: int = 2
     dtype: Any = jnp.float32
+    attn_fn: Any = "auto"
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
                  deterministic: bool = True):
-        _, pooled = BertEncoder(self.cfg, self.dtype, name="bert")(
+        _, pooled = BertEncoder(self.cfg, self.dtype, self.attn_fn,
+                                name="bert")(
             input_ids, attention_mask, token_type_ids, deterministic)
         pooled = nn.Dropout(self.cfg.dropout_rate)(
             pooled, deterministic=deterministic)

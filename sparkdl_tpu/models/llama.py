@@ -101,10 +101,14 @@ class LoRADense(nn.Module):
 
 
 def rope(x, positions, theta: float):
-    """Rotary position embedding. x: [B, H, S, D], positions: [S]."""
+    """Rotary position embedding. x: [B, H, S, D]; positions: [S] (shared)
+    or [B, S] (per-row — left-padded serving, where row r's first real
+    token sits at a different slot)."""
     d = x.shape[-1]
     freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
-    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # [S,D/2]
+    angles = positions.astype(jnp.float32)[..., None] * freqs  # [...,S,D/2]
+    if angles.ndim == 3:
+        angles = angles[:, None]  # [B, 1, S, D/2] broadcasts over heads
     cos, sin = jnp.cos(angles), jnp.sin(angles)
     x1, x2 = x[..., 0::2], x[..., 1::2]
     xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
@@ -117,10 +121,12 @@ def rope(x, positions, theta: float):
 class LlamaAttention(nn.Module):
     cfg: LlamaConfig
     dtype: Any = jnp.float32
-    attn_fn: Optional[Callable] = None  # (q,k,v,causal=...) → o
+    # (q,k,v,causal=...) → o; "auto" (default) resolves to the Pallas flash
+    # kernel on TPU and in-model dense attention elsewhere (ops.resolve_attn_fn)
+    attn_fn: Any = "auto"
 
     @nn.compact
-    def __call__(self, x, positions, decode: bool = False):
+    def __call__(self, x, positions, decode: bool = False, pad_lens=None):
         c, d = self.cfg, self.dtype
         B, S, _ = x.shape
         hd = c.head_dim
@@ -142,14 +148,14 @@ class LlamaAttention(nn.Module):
             # sequence length (= max_len); apply() calls then write chunks —
             # the whole prompt at prefill, one token per decode step — at the
             # running index. See ``init_cache``/``generate``.
+            # ``pad_lens`` [B] (left-padded serving): row r's first pad_lens[r]
+            # cache slots are dead — masked out of attention, and rope
+            # positions count from the first REAL token, so ONE compiled
+            # prefill serves every prompt length (udf.registerGenerationUDF).
             # NB: ``attn_fn`` (ring/Ulysses/flash) applies to the training
-            # path only; cache attention is computed here. Sequence-parallel
-            # serving is a future kernel (cache-aware flash decode).
-            if self.attn_fn is not None and not self.is_initializing():
-                import logging
-                logging.getLogger(__name__).warning(
-                    "LlamaAttention: attn_fn is ignored in decode mode; "
-                    "generation uses dense cache attention")
+            # path only; cache attention is computed here (generate() warns
+            # host-side once). Sequence-parallel serving is a future kernel
+            # (cache-aware flash decode).
             k_cache = self.variable("cache", "k", jnp.zeros,
                                     (B, c.num_kv_heads, S, hd), d)
             v_cache = self.variable("cache", "v", jnp.zeros,
@@ -158,7 +164,15 @@ class LlamaAttention(nn.Module):
                                 lambda: jnp.zeros((), jnp.int32))
             if not self.is_initializing():
                 cur = idx.value
-                pos = cur + jnp.arange(S)
+                if pad_lens is None:
+                    pos = cur + jnp.arange(S)  # [S], shared across rows
+                    valid_extra = None
+                else:
+                    # per-row positions relative to the first real token
+                    pos = jnp.maximum(
+                        cur + jnp.arange(S)[None, :]
+                        - pad_lens[:, None], 0)  # [B, S]
+                    valid_extra = pad_lens
                 q = rope(q, pos, c.rope_theta)
                 k = rope(k, pos, c.rope_theta)
                 k_all = jax.lax.dynamic_update_slice(
@@ -176,7 +190,13 @@ class LlamaAttention(nn.Module):
                                k_all) / math.sqrt(hd)
                 col = jnp.arange(max_len)[None, :]
                 row = cur + jnp.arange(S)[:, None]
-                s = jnp.where(col <= row, s.astype(jnp.float32), -1e30)
+                valid = (col <= row)  # [S, max_len] causal-vs-cache
+                if valid_extra is not None:
+                    # [B, S, max_len]: also exclude each row's pad slots
+                    valid = valid[None] & (
+                        col[None] >= valid_extra[:, None, None])
+                    valid = valid[:, None, None]  # [B,1,1,S,max_len]
+                s = jnp.where(valid, s.astype(jnp.float32), -1e30)
                 p = jax.nn.softmax(s, axis=-1).astype(d)
                 o = jnp.einsum("bgrqk,bgkd->bgrqd", p, v_all).reshape(
                     B, c.num_heads, S, hd)
@@ -188,8 +208,10 @@ class LlamaAttention(nn.Module):
             if rep != 1:
                 k = jnp.repeat(k, rep, axis=1)
                 v = jnp.repeat(v, rep, axis=1)
-            if self.attn_fn is not None:
-                o = self.attn_fn(q, k, v, causal=True)
+            from ..ops.flash_attention import resolve_attn_fn
+            attn_fn = resolve_attn_fn(self.attn_fn)
+            if attn_fn is not None:
+                o = attn_fn(q, k, v, causal=True)
             else:
                 s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(hd)
                 mask = jnp.tril(jnp.ones((S, S), bool))
@@ -223,13 +245,14 @@ class LlamaMLP(nn.Module):
 class LlamaLayer(nn.Module):
     cfg: LlamaConfig
     dtype: Any = jnp.float32
-    attn_fn: Optional[Callable] = None
+    attn_fn: Any = "auto"
 
     @nn.compact
-    def __call__(self, x, positions, decode: bool = False):
+    def __call__(self, x, positions, decode: bool = False, pad_lens=None):
         c = self.cfg
         x = x + LlamaAttention(c, self.dtype, self.attn_fn, name="attn")(
-            RMSNorm(c.rms_norm_eps, name="attn_norm")(x), positions, decode)
+            RMSNorm(c.rms_norm_eps, name="attn_norm")(x), positions, decode,
+            pad_lens)
         x = x + LlamaMLP(c, self.dtype, name="mlp")(
             RMSNorm(c.rms_norm_eps, name="mlp_norm")(x))
         return x
@@ -239,10 +262,10 @@ class LlamaModel(nn.Module):
     """Token ids [B, S] → logits [B, S, vocab]."""
     cfg: LlamaConfig
     dtype: Any = jnp.float32
-    attn_fn: Optional[Callable] = None
+    attn_fn: Any = "auto"  # flash on TPU, dense elsewhere; or a callable
 
     @nn.compact
-    def __call__(self, input_ids, decode: bool = False):
+    def __call__(self, input_ids, decode: bool = False, pad_lens=None):
         c = self.cfg
         S = input_ids.shape[1]
         positions = jnp.arange(S)
@@ -250,7 +273,7 @@ class LlamaModel(nn.Module):
                      name="embed_tokens")(input_ids)
         for i in range(c.num_layers):
             x = LlamaLayer(c, self.dtype, self.attn_fn,
-                           name=f"layer_{i}")(x, positions, decode)
+                           name=f"layer_{i}")(x, positions, decode, pad_lens)
         x = RMSNorm(c.rms_norm_eps, name="final_norm")(x)
         return nn.Dense(c.vocab_size, use_bias=False, dtype=jnp.float32,
                         name="lm_head")(x)
@@ -281,18 +304,21 @@ def _sample(logits, key, temperature: float):
 
 
 @functools.partial(jax.jit, static_argnames=("model",))
-def _prefill(model, params, prompt_ids, cache):
+def _prefill(model, params, prompt_ids, cache, pad_lens=None):
     """Whole prompt in one chunked cache write → (last-pos logits, cache).
-    Compiled per (batch, prompt_len, max_len) signature."""
+    Compiled per (batch, prompt_len, max_len) signature. With left-padded
+    prompts (``pad_lens``), ONE (batch, Lmax, max_len) program serves every
+    prompt length — the newest real token is always the last position."""
     logits, mut = model.apply({"params": params, "cache": cache},
-                              prompt_ids, decode=True, mutable=["cache"])
+                              prompt_ids, decode=True, pad_lens=pad_lens,
+                              mutable=["cache"])
     return logits[:, -1].astype(jnp.float32), mut["cache"]
 
 
 @functools.partial(
     jax.jit, static_argnames=("model", "max_new_tokens", "temperature"))
-def _decode(model, params, cache, last_logits, rng, *, max_new_tokens: int,
-            temperature: float):
+def _decode(model, params, cache, last_logits, rng, pad_lens=None, *,
+            max_new_tokens: int, temperature: float):
     """lax.scan: one token per step. Compiled per (batch, max_len)
     signature — independent of the prompt length, so varying-length prompts
     with a shared cache size reuse ONE decode program."""
@@ -305,7 +331,7 @@ def _decode(model, params, cache, last_logits, rng, *, max_new_tokens: int,
         cache, tok, rng = carry
         logits, mut = model.apply({"params": params, "cache": cache},
                                   tok[:, None], decode=True,
-                                  mutable=["cache"])
+                                  pad_lens=pad_lens, mutable=["cache"])
         rng, key = jax.random.split(rng)
         nxt = _sample(logits[:, -1].astype(jnp.float32), key, temperature)
         return (mut["cache"], nxt, rng), tok
@@ -315,18 +341,52 @@ def _decode(model, params, cache, last_logits, rng, *, max_new_tokens: int,
     return jnp.moveaxis(toks, 0, 1)
 
 
+def left_pad_prompts(prompts, pad_id: int = 0):
+    """Variable-length prompt lists → (ids [B, Lmax] left-padded, pad_lens
+    [B]). Left padding keeps every row's newest token at the last position,
+    so one prefill program + one decode program serve mixed lengths."""
+    import numpy as np
+    lens = [len(p) for p in prompts]
+    if min(lens, default=0) < 1:
+        raise ValueError("every prompt needs at least one token id")
+    lmax = max(lens)
+    ids = np.full((len(prompts), lmax), pad_id, dtype=np.int32)
+    for r, p in enumerate(prompts):
+        ids[r, lmax - len(p):] = np.asarray(p, dtype=np.int32)
+    return ids, np.asarray([lmax - n for n in lens], dtype=np.int32)
+
+
+_warned_attn_fn_ignored = False
+
+
 def generate(model: LlamaModel, variables, prompt_ids, max_new_tokens: int,
-             temperature: float = 0.0, rng=None, pad_to: int | None = None):
+             temperature: float = 0.0, rng=None, pad_to: int | None = None,
+             pad_lens=None):
     """Greedy / temperature sampling with a KV cache.
 
     Two jitted programs: a prefill pass writes the prompt's cache in a
-    single chunked update (compiled per prompt length), then a ``lax.scan``
-    decode emits one token per step (compiled per (batch, cache-size) only —
-    pass ``pad_to`` to fix the cache size so varying prompt lengths share
-    one decode program).
+    single chunked update, then a ``lax.scan`` decode emits one token per
+    step (compiled per (batch, cache-size) only). For mixed-length columns,
+    left-pad with :func:`left_pad_prompts` and pass ``pad_lens`` — the
+    prefill then also compiles ONCE for the whole column (positions count
+    from each row's first real token; pad slots are masked out of
+    attention).
 
-    ``prompt_ids``: [B, Lp] int32, Lp >= 1. Returns [B, Lp+max_new_tokens].
+    ``prompt_ids``: [B, Lp] int32, Lp >= 1. Returns [B, Lp+max_new_tokens]
+    (left-pad slots included when ``pad_lens`` is used — strip
+    ``pad_lens[r]`` leading ids per row).
     """
+    global _warned_attn_fn_ignored
+    # Warn only for an EXPLICITLY configured attn_fn — the "auto" default
+    # resolving to flash for training is not a user setting being ignored.
+    if callable(model.attn_fn) and not _warned_attn_fn_ignored:
+        # Host-side, once — not inside the traced apply (fires per trace).
+        import logging
+        logging.getLogger(__name__).warning(
+            "LlamaModel.attn_fn is ignored during generation; decode uses "
+            "dense cache attention (sequence-parallel serving is a future "
+            "cache-aware kernel)")
+        _warned_attn_fn_ignored = True
     prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
     b, lp = prompt_ids.shape
     if lp < 1:
@@ -338,9 +398,11 @@ def generate(model: LlamaModel, variables, prompt_ids, max_new_tokens: int,
     params = variables["params"] if "params" in variables else variables
     if rng is None:
         rng = jax.random.PRNGKey(0)
+    if pad_lens is not None:
+        pad_lens = jnp.asarray(pad_lens, jnp.int32)
     cache = init_cache(model, b, int(max_len))
-    last_logits, cache = _prefill(model, params, prompt_ids, cache)
-    toks = _decode(model, params, cache, last_logits, rng,
+    last_logits, cache = _prefill(model, params, prompt_ids, cache, pad_lens)
+    toks = _decode(model, params, cache, last_logits, rng, pad_lens,
                    max_new_tokens=int(max_new_tokens),
                    temperature=float(temperature))
     return jnp.concatenate([prompt_ids, toks], axis=1)

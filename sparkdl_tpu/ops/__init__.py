@@ -1,5 +1,5 @@
 """Pallas TPU kernels for the hot ops (SURVEY.md §5.7, pallas guide)."""
 
-from .flash_attention import flash_attention
+from .flash_attention import auto_attn_fn, flash_attention, resolve_attn_fn
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "auto_attn_fn", "resolve_attn_fn"]

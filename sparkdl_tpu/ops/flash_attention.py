@@ -15,15 +15,21 @@ Design (the standard streaming-softmax factorization, written for the MXU):
 - S·S attention scores never materialize and no full K/V is ever VMEM
   resident — VMEM holds one Q, K, V tile + one (BQ, BK) score tile, so
   sequence length is bounded by HBM, not VMEM.
-- causal masking prunes whole KV tiles: the fori_loop upper bound for query
-  tile ``qi`` covers only tiles at-or-below the diagonal.
+- causal masking prunes whole KV tiles: dead tiles are skipped via pl.when.
+- ``kv_mask`` ([B, S] 0/1) streams as (1, BK) tiles and masks padded key
+  positions — the BERT attention-mask contract, so flash drops into padded
+  encoder batches, not just causal LMs.
+- the logsumexp output is blocked (1, BQ) per q-tile program — every store
+  is a full-block write, no dynamic lane-dim slicing (round-1 advisor
+  flagged the previous ``pl.ds`` store as a Mosaic alignment risk).
 - backward: custom_vjp with blockwise recompute (lax.scan over KV tiles in
   plain jax) from the saved (o, logsumexp) — activations are O(S·D), the
   flash-attention memory contract, and XLA keeps the per-tile recompute on
   the MXU.
 
 ``interpret=True`` (or platform != tpu) runs the same kernel through the
-Pallas interpreter — how CPU tests validate kernel semantics.
+Pallas interpreter — how CPU tests validate kernel semantics; a TPU-gated
+compiled-mode test runs in the bench environment.
 """
 
 from __future__ import annotations
@@ -41,8 +47,9 @@ NEG_INF = -1e30
 _LANES = 128  # per-row stats live broadcast across one lane tile
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-                *, causal: bool, sm_scale: float, seq_len: int):
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, acc_ref,
+                m_ref, l_ref, *, causal: bool, sm_scale: float,
+                seq_len: int):
     """Grid = (B·H, Q tiles, KV tiles); KV tiles stream through VMEM via the
     innermost grid dimension (pallas pipelines the HBM loads), while the
     (BQ, D) accumulator and per-row (m, l) stats persist in VMEM scratch
@@ -71,6 +78,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         col_ids = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         mask = col_ids < seq_len
+        mask = mask & (mask_ref[0].astype(jnp.float32)[None, :] > 0)
         if causal:
             row_ids = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -80,6 +88,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         l_prev = l_ref[:, 0]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
+        # fully-masked-so-far rows: keep the accumulator at exact zero
+        p = jnp.where(m_new[:, None] <= NEG_INF, 0.0, p)
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1)
         acc_ref[:] = acc_ref[:] * alpha[:, None] + jnp.dot(
@@ -91,36 +101,37 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     def _finalize():
         m = m_ref[:, 0]
         l = l_ref[:, 0]
-        safe_l = jnp.where(l > 0, l, 1.0)  # fully-masked rows (seq padding)
+        safe_l = jnp.where(l > 0, l, 1.0)  # fully-masked rows (padding)
         o_ref[0] = (acc_ref[:] / safe_l[:, None]).astype(o_ref.dtype)
-        # lse block spans the whole row (TPU block-shape rules); this
-        # program owns [qi*BQ, qi*BQ+BQ) and the block revisits across qi.
-        lse_ref[0, 0, pl.ds(qi * block_q, block_q)] = m + jnp.log(safe_l)
+        # lse is blocked (1, BQ) per q-tile: a full-block store, no dynamic
+        # lane-dim slicing (Mosaic-safe for any block_q).
+        lse_ref[0, :] = m + jnp.log(safe_l)
 
 
-def _fwd(q, k, v, causal: bool, block_q: int, block_k: int,
+def _fwd(q, k, v, kv_mask, causal: bool, block_q: int, block_k: int,
          interpret: bool):
     b, h, s, d = q.shape
     bq = min(block_q, s)
     bk = min(block_k, s)
-    # In-kernel pl.ds must never cross the buffer end: pad S up to a common
-    # multiple of both tile sizes; masking uses the true length and padded
-    # rows are sliced off after.
     unit = math.lcm(bq, bk)
     s_pad = pl.cdiv(s, unit) * unit
     sm_scale = 1.0 / math.sqrt(d)
     q3 = q.reshape(b * h, s, d)
     k3 = k.reshape(b * h, s, d)
     v3 = v.reshape(b * h, s, d)
+    # [B, S] 0/1 kv mask → (B*H, S) f32 stream (tiny next to K/V tiles)
+    m2 = jnp.broadcast_to(kv_mask.astype(jnp.float32)[:, None, :],
+                          (b, h, s)).reshape(b * h, s)
     if s_pad != s:
         padding = ((0, 0), (0, s_pad - s), (0, 0))
         q3 = jnp.pad(q3, padding)
         k3 = jnp.pad(k3, padding)
         v3 = jnp.pad(v3, padding)
+        m2 = jnp.pad(m2, ((0, 0), (0, s_pad - s)))
     from jax.experimental.pallas import tpu as pltpu
 
     grid = (b * h, s_pad // bq, s_pad // bk)
-    o3, lse3 = pl.pallas_call(
+    o3, lse2 = pl.pallas_call(
         functools.partial(_fwd_kernel, causal=causal,
                           sm_scale=sm_scale, seq_len=s),
         grid=grid,
@@ -128,14 +139,15 @@ def _fwd(q, k, v, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, bk), lambda bh, i, j: (bh, j)),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, 1, s_pad), lambda bh, i, j: (bh, 0, 0)),
+            pl.BlockSpec((1, bq), lambda bh, i, j: (bh, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, s_pad, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, 1, s_pad), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, s_pad), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),        # acc
@@ -143,12 +155,12 @@ def _fwd(q, k, v, causal: bool, block_q: int, block_k: int,
             pltpu.VMEM((bq, _LANES), jnp.float32),   # normalizer l
         ],
         interpret=interpret,
-    )(q3, k3, v3)
+    )(q3, k3, v3, m2)
     return (o3[:, :s].reshape(b, h, s, d),
-            lse3[:, 0, :s].reshape(b, h, s))
+            lse2[:, :s].reshape(b, h, s))
 
 
-def _bwd_one_head(q, k, v, o, lse, do, causal: bool, block_k: int,
+def _bwd_one_head(q, k, v, o, lse, do, kv_mask, causal: bool, block_k: int,
                   sm_scale: float):
     """Blockwise backward for one (S, D) head, plain jax (runs under vmap).
 
@@ -163,6 +175,9 @@ def _bwd_one_head(q, k, v, o, lse, do, causal: bool, block_k: int,
         v = jnp.pad(v, ((0, pad), (0, 0)))
     kb = k.reshape(n_blocks, bk, d)
     vb = v.reshape(n_blocks, bk, d)
+    maskp = jnp.pad(kv_mask.astype(jnp.float32), (0, pad)) if pad \
+        else kv_mask.astype(jnp.float32)
+    mb = maskp.reshape(n_blocks, bk)
 
     qf = q.astype(jnp.float32) * sm_scale
     dof = do.astype(jnp.float32)
@@ -174,7 +189,7 @@ def _bwd_one_head(q, k, v, o, lse, do, causal: bool, block_k: int,
         vj = vb[j].astype(jnp.float32)
         s_tile = qf @ kj.T                                   # (S, BK)
         col_ids = j * bk + jnp.arange(bk)
-        mask = col_ids[None, :] < s_len
+        mask = (col_ids[None, :] < s_len) & (mb[j][None, :] > 0)
         if causal:
             mask = mask & (col_ids[None, :] <= row_ids[:, None])
         p = jnp.where(mask, jnp.exp(s_tile - lse[:, None]), 0.0)
@@ -192,17 +207,52 @@ def _bwd_one_head(q, k, v, o, lse, do, causal: bool, block_k: int,
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
-                    block_k: int = 128, interpret: bool | None = None):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_core(q, k, v, kv_mask, causal: bool, block_q: int, block_k: int,
+                interpret: bool):
+    o, _ = _fwd(q, k, v, kv_mask, causal, block_q, block_k, interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, kv_mask, causal, block_q, block_k, interpret):
+    o, lse = _fwd(q, k, v, kv_mask, causal, block_q, block_k, interpret)
+    return o, (q, k, v, kv_mask, o, lse)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, do):
+    q, k, v, kv_mask, o, lse = res
+    sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    bwd = functools.partial(_bwd_one_head, causal=causal, block_k=block_k,
+                            sm_scale=sm_scale)
+    # vmap over batch then heads; the kv mask is per-batch (broadcast over
+    # heads via in_axes=None on the inner vmap)
+    dq, dk, dv = jax.vmap(jax.vmap(bwd, in_axes=(0, 0, 0, 0, 0, 0, None)))(
+        q, k, v, o, lse, do, kv_mask)
+    return dq, dk, dv, jnp.zeros_like(kv_mask)
+
+
+_flash_core.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False, kv_mask=None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool | None = None):
     """Pallas flash attention. q/k/v: ``[B, H, S, D]`` → ``[B, H, S, D]``.
 
+    ``kv_mask``: optional ``[B, S]`` 0/1 array — key positions with 0 are
+    excluded from every query's softmax (the BERT attention-mask contract).
     ``interpret=None`` auto-selects: compiled kernel on TPU, interpreter
     elsewhere (CPU tests). Same (q, k, v, causal=...) signature as
-    ``parallel.dense_attention``, so it drops into ``LlamaModel(attn_fn=…)``.
+    ``parallel.dense_attention``, so it drops into ``LlamaModel(attn_fn=…)``
+    and ``BertEncoder(attn_fn=…)``.
     """
-    o, _ = _fwd(q, k, v, causal, block_q, block_k, _resolve(interpret))
-    return o
+    b, _, s, _ = q.shape
+    if kv_mask is None:
+        kv_mask = jnp.ones((b, s), jnp.float32)
+    else:
+        kv_mask = kv_mask.astype(jnp.float32)
+    return _flash_core(q, k, v, kv_mask, causal, block_q, block_k,
+                       _resolve(interpret))
 
 
 def _resolve(interpret: bool | None) -> bool:
@@ -211,19 +261,22 @@ def _resolve(interpret: bool | None) -> bool:
     return interpret
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    o, lse = _fwd(q, k, v, causal, block_q, block_k, _resolve(interpret))
-    return o, (q, k, v, o, lse)
+def auto_attn_fn():
+    """The default-attention policy: the compiled flash kernel on TPU,
+    ``None`` (dense attention in-model) elsewhere. Models accept the
+    returned value as their ``attn_fn``; pass through to
+    ``LlamaModel(attn_fn=auto_attn_fn())`` / ``BertEncoder(attn_fn=…)``.
+    """
+    if jax.default_backend() == "tpu":
+        return flash_attention
+    return None
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, res, do):
-    q, k, v, o, lse = res
-    sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    bwd = functools.partial(_bwd_one_head, causal=causal, block_k=block_k,
-                            sm_scale=sm_scale)
-    # vmap over batch then heads
-    dq, dk, dv = jax.vmap(jax.vmap(bwd))(q, k, v, o, lse, do)
-    return dq, dk, dv
-
-
-flash_attention.defvjp(_flash_fwd, _flash_bwd)
+def resolve_attn_fn(attn_fn):
+    """Model-side resolver: the sentinel ``"auto"`` (the BERT/Llama module
+    default) becomes :func:`auto_attn_fn`'s pick at TRACE time — flash on
+    TPU, in-model dense elsewhere; any explicit callable or None passes
+    through untouched."""
+    if attn_fn == "auto":
+        return auto_attn_fn()
+    return attn_fn

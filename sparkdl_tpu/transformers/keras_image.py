@@ -10,6 +10,8 @@ on the TPU (the BatchRunner prefetch overlap).
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 import pyarrow as pa
 
@@ -19,7 +21,7 @@ from ..core.params import (HasBatchSize, HasInputCol, HasOutputCol, Param,
 from ..core.pipeline import Transformer
 from ..core.runtime import BatchRunner
 from .keras_utils import keras_file_to_fn
-from .payloads import PicklesCallableParams
+from .payloads import BundlesModelFile, PicklesCallableParams
 from .xla_image import arrayColumnToArrow
 
 
@@ -34,10 +36,13 @@ def defaultImageLoader(size: tuple[int, int]):
     return load
 
 
-class KerasImageFileTransformer(PicklesCallableParams, Transformer,
-                                HasInputCol, HasOutputCol, HasBatchSize):
+class KerasImageFileTransformer(BundlesModelFile, PicklesCallableParams,
+                                Transformer, HasInputCol, HasOutputCol,
+                                HasBatchSize):
     """Loads images from a URI column via ``imageLoader`` and applies a saved
-    Keras model (``modelFile``, Keras-3-on-JAX) as one jitted XLA program."""
+    Keras model (``modelFile``, Keras-3-on-JAX) as one jitted XLA program.
+    save() bundles the model file with the stage (BundlesModelFile), so
+    fitted transformers persist durably."""
 
     modelFile = Param(Params, "modelFile", "path to a saved Keras model "
                       "(.keras/.h5)", TypeConverters.toString)

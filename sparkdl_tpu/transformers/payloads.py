@@ -27,3 +27,31 @@ class PicklesCallableParams:
             if os.path.exists(fpath):
                 with open(fpath, "rb") as f:
                     self._set(**{name: cloudpickle.load(f)})
+
+
+class BundlesModelFile:
+    """Persistence mixin for stages with a ``modelFile`` path param: save()
+    copies the model file INTO the stage directory and load() rebinds the
+    param to the bundled copy — a fitted transformer whose modelFile points
+    at a temp dir (KerasImageFileEstimator._fit) survives process exit,
+    host moves, and temp-dir cleanup (SURVEY.md §5.4 durability).
+
+    MRO note: place before PicklesCallableParams so both payload hooks run
+    (each calls super())."""
+
+    def _save_payload(self, path: str):
+        super()._save_payload(path)
+        if self.isDefined("modelFile"):
+            import shutil
+            src = self.getOrDefault("modelFile")
+            if os.path.exists(src):
+                shutil.copyfile(src, os.path.join(
+                    path, "model" + os.path.splitext(src)[1]))
+
+    def _load_payload(self, path: str, meta: dict):
+        super()._load_payload(path, meta)
+        for ext in (".keras", ".h5", ".hdf5"):
+            bundled = os.path.join(path, "model" + ext)
+            if os.path.exists(bundled):
+                self._set(modelFile=bundled)
+                break

@@ -18,7 +18,7 @@ from ..core.params import (HasBatchSize, HasInputCol, HasOutputCol, Param,
 from ..core.pipeline import Transformer
 from ..core.runtime import BatchRunner
 from .keras_utils import keras_file_to_fn
-from .payloads import PicklesCallableParams
+from .payloads import BundlesModelFile, PicklesCallableParams
 from .xla_image import arrayColumnToArrow
 
 
@@ -104,9 +104,10 @@ class XlaTransformer(PicklesCallableParams, Transformer, HasInputCol,
     _pickled_params = ("fn",)
 
 
-class KerasTransformer(XlaTransformer):
+class KerasTransformer(BundlesModelFile, XlaTransformer):
     """Applies a saved Keras model (Keras-3-on-JAX) to a 1-D array column —
-    the reference's KerasTransformer (single input/output tensor contract)."""
+    the reference's KerasTransformer (single input/output tensor contract).
+    save() bundles the model file with the stage (BundlesModelFile)."""
 
     modelFile = Param(Params, "modelFile",
                       "path to a saved Keras model (.keras/.h5)",

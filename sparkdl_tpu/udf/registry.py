@@ -86,52 +86,61 @@ def registerKerasImageUDF(udf_name: str, keras_model_or_file,
 
 def registerGenerationUDF(name: str, model, variables,
                           max_new_tokens: int = 32,
-                          temperature: float = 0.0, seed: int = 0) -> None:
+                          temperature: float = 0.0, seed: int = 0,
+                          batchRows: int = 64) -> None:
     """Register a text-generation UDF over token-id columns — the
     ``registerUDF`` batch-inference half of BASELINE config 5 ("Llama LoRA
     fine-tune via XlaRunner + registerUDF batch inference").
 
-    The column holds int token-id lists (prompts). Rows are grouped by
-    prompt length and each group decodes as ONE compiled KV-cache program
-    (prefill + lax.scan) — two XLA programs per distinct prompt length.
+    The column holds int token-id lists (prompts). The whole column is
+    LEFT-padded to one length (``models.llama.left_pad_prompts``) and runs
+    as exactly TWO compiled XLA programs however many distinct prompt
+    lengths appear: one masked prefill (positions count from each row's
+    first real token) + one ``lax.scan`` decode. No duplicate-row fill, no
+    per-length recompiles. Rows are chunked to ``batchRows`` so a huge
+    column doesn't build one giant cache (chunks of equal row count reuse
+    the same programs).
     """
     import jax
     import numpy as np
 
-    from ..models.llama import generate
+    from ..models.llama import generate, left_pad_prompts
 
     def apply(df: DataFrame, inputCol: str, outputCol: str) -> DataFrame:
         import pandas as pd
         pdf = df.toPandas()
-        prompts = [np.asarray(p, dtype=np.int32)
-                   for p in pdf[inputCol].to_list()]
-        out: list = [None] * len(prompts)
-        by_len: dict[int, list[int]] = {}
+        prompts = pdf[inputCol].to_list()
         for i, p in enumerate(prompts):
             if len(p) == 0:
                 raise ValueError(
                     f"{inputCol!r} row {i} is an empty prompt; every row "
                     f"needs at least one token id")
-            by_len.setdefault(len(p), []).append(i)
-        # One compiled decode program for ALL groups: fix the cache size
-        # (pad_to) and pad each group's batch to a common row count with
-        # repeated rows (discarded after). Prefill still compiles once per
-        # distinct prompt length — inherent without attention masks.
-        pad_to = max(by_len) + max_new_tokens if by_len else 0
-        batch_rows = max(len(v) for v in by_len.values()) if by_len else 0
+        out: list = [None] * len(prompts)
         rng = jax.random.PRNGKey(seed)
-        for _, idxs in sorted(by_len.items()):
-            batch = np.stack([prompts[i] for i in idxs])
-            if len(idxs) < batch_rows:
-                fill = np.repeat(batch[:1], batch_rows - len(idxs), axis=0)
-                batch = np.concatenate([batch, fill], axis=0)
-            rng, key = jax.random.split(rng)
-            gen = np.asarray(generate(model, variables, batch,
-                                      max_new_tokens,
-                                      temperature=temperature, rng=key,
-                                      pad_to=pad_to))
-            for row, i in enumerate(idxs):
-                out[i] = gen[row].tolist()
+        if prompts:
+            ids_all, pads_all = left_pad_prompts(prompts)
+            lmax = ids_all.shape[1]
+            for start in range(0, len(prompts), batchRows):
+                ids = ids_all[start:start + batchRows]
+                pads = pads_all[start:start + batchRows]
+                # pad the trailing chunk's ROWS up to batchRows so every
+                # chunk hits the same compiled (rows, lmax) signature; fill
+                # rows are all-pad dummies sliced off below
+                n = len(ids)
+                if n < batchRows and start > 0:
+                    fill = batchRows - n
+                    ids = np.concatenate(
+                        [ids, np.repeat(ids[:1], fill, axis=0)])
+                    pads = np.concatenate(
+                        [pads, np.repeat(pads[:1], fill, axis=0)])
+                rng, key = jax.random.split(rng)
+                gen = np.asarray(generate(
+                    model, variables, ids, max_new_tokens,
+                    temperature=temperature, rng=key,
+                    pad_to=lmax + max_new_tokens, pad_lens=pads))
+                for row in range(n):
+                    # strip this row's left pads: real prompt + new tokens
+                    out[start + row] = gen[row, pads[row]:].tolist()
         pdf = pdf.copy()
         pdf[outputCol] = pd.Series(out, index=pdf.index)
         return DataFrame.fromPandas(pdf, numPartitions=df.numPartitions)

@@ -449,3 +449,41 @@ def test_image_input_placeholder_and_utils():
     with Timer() as t:
         pass
     assert t.seconds >= 0.0
+
+
+def test_as_graph_function_validates_placeholders_at_export():
+    """An output depending on an undeclared placeholder must fail at
+    asGraphFunction (export) time, not with 'No feed provided' at call time
+    (ADVICE r1 item 4)."""
+    import sparkdl_tpu as sdl
+    with sdl.IsolatedSession() as issn:
+        x = issn.placeholder(name="x")
+        y = issn.placeholder(name="y")
+        z = x + y
+        with pytest.raises(ValueError, match=r"placeholder.*'y'"):
+            issn.asGraphFunction([x], [z])
+        gfn = issn.asGraphFunction([x, y], [z])  # declared: fine
+        out = gfn({"x": np.ones(2, np.float32), "y": np.ones(2, np.float32)})
+        np.testing.assert_allclose(out[gfn.output_names[0]], 2.0)
+
+
+def test_probe_output_names_via_eval_shape():
+    """With input_specs, undeclared multi-output fns fail at construction;
+    dict returns get their keys as output names (round-2 verdict weak #8)."""
+    from sparkdl_tpu.graph.function import GraphFunction
+
+    specs = {"input": ((None, 3), "float32")}
+    # dict return: names inferred abstractly, no compute
+    gfn = GraphFunction.fromJax(
+        lambda x: {"a": x * 2, "b": x + 1}, input_specs=specs)
+    assert gfn.output_names == ["a", "b"]
+
+    # undeclared tuple multi-output: construction-time error
+    with pytest.raises(ValueError, match="declare output_names"):
+        GraphFunction.fromJax(lambda x: (x, x * 2), input_specs=specs)
+
+    # without specs: permissive default, error still surfaces at call
+    gfn2 = GraphFunction.fromJax(lambda x: (x, x * 2))
+    assert gfn2.output_names == ["output"]
+    with pytest.raises(ValueError):
+        gfn2({"input": np.ones((2, 3), np.float32)})

@@ -93,3 +93,52 @@ class TestKerasImageFileEstimator:
             optimizer="lion9000")
         with pytest.raises(ValueError):
             est.fit(_df(n=16))
+
+
+def test_fitted_transformer_survives_model_file_deletion(
+        keras_model_file, tmp_path):
+    """Durable persistence of the FITTED estimator output (round-1 task 5 /
+    round-2 verdict missing #3): save() must bundle the trained weights with
+    the transformer, so the temp file _fit wrote can vanish and a fresh
+    load still reproduces predictions."""
+    import sparkdl_tpu as sdl
+    est = KerasImageFileEstimator(
+        inputCol="uri", outputCol="pred", labelCol="label",
+        modelFile=keras_model_file, imageLoader=synthetic_loader,
+        batchSize=8, epochs=1, learningRate=0.05)
+    df = _df(16, 2)
+    fitted = est.fit(df)
+    before = np.stack([np.asarray(r.pred, np.float32)
+                       for r in fitted.transform(df).collect()])
+
+    p = str(tmp_path / "fitted")
+    fitted.save(p)
+    # simulate process exit / tmp cleanup: remove the temp trained file
+    tmp_model = fitted.getOrDefault(fitted.modelFile)
+    os.remove(tmp_model)
+
+    loaded = sdl.load(p)
+    assert loaded.getOrDefault(loaded.modelFile) != tmp_model
+    after = np.stack([np.asarray(r.pred, np.float32)
+                      for r in loaded.transform(df).collect()])
+    np.testing.assert_allclose(after, before, rtol=1e-5, atol=1e-6)
+
+
+def test_keras_transformer_save_bundles_model(tmp_path):
+    """KerasTransformer.save copies the model file into the stage dir."""
+    os.environ.setdefault("KERAS_BACKEND", "jax")
+    import keras
+    import sparkdl_tpu as sdl
+    m = keras.Sequential([keras.Input((3,)), keras.layers.Dense(2)])
+    src = str(tmp_path / "m.keras")
+    m.save(src)
+    t = sdl.KerasTransformer(inputCol="x", outputCol="y", modelFile=src,
+                             batchSize=2)
+    df = sdl.DataFrame.fromPydict({"x": [[1.0, 2.0, 3.0], [0.0, 1.0, 0.0]]})
+    want = [r.y for r in t.transform(df).collect()]
+    p = str(tmp_path / "stage")
+    t.save(p)
+    os.remove(src)
+    loaded = sdl.load(p)
+    got = [r.y for r in loaded.transform(df).collect()]
+    np.testing.assert_allclose(got, want, rtol=1e-6)

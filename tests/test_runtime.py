@@ -151,3 +151,46 @@ def test_background_iter_cancellation_releases_producer():
         time.sleep(0.05)
     assert threading.active_count() <= before, "producer thread leaked"
     assert len(produced) < 100, "producer ran unbounded after close"
+
+
+def test_make_mesh_topology_aware_dispatch(monkeypatch):
+    """On multi-chip TPU device sets make_mesh must route through
+    mesh_utils.create_device_mesh (ICI-torus-aware placement — BASELINE
+    "chip-topology aware"); CPU/virtual devices use the plain reshape, and
+    a mesh_utils failure degrades to reshape with a warning, not an error."""
+    calls = []
+
+    class FakeTpu:
+        platform = "tpu"
+
+        def __init__(self, i):
+            self.id = i
+
+        def __repr__(self):
+            return f"FakeTpu({self.id})"
+
+    fakes = [FakeTpu(i) for i in range(8)]
+
+    from jax.experimental import mesh_utils as mu
+
+    def fake_create(shape, devices=None):
+        calls.append(tuple(shape))
+        return np.array(devices).reshape(shape)
+
+    monkeypatch.setattr(mu, "create_device_mesh", fake_create)
+    grid = runtime._device_grid(fakes, [4, 2])
+    assert calls == [(4, 2)] and grid.shape == (4, 2)
+
+    # CPU devices: no mesh_utils call
+    mesh = runtime.make_mesh({"data": 4, "model": 2},
+                             devices_=jax.devices()[:8])
+    assert mesh.shape == {"data": 4, "model": 2}
+    assert calls == [(4, 2)]  # unchanged — cpu path didn't call it
+
+    # mesh_utils blowing up degrades to reshape
+    def boom(shape, devices=None):
+        raise ValueError("no topology")
+
+    monkeypatch.setattr(mu, "create_device_mesh", boom)
+    grid = runtime._device_grid(fakes, [8])
+    assert [d.id for d in grid] == list(range(8))
