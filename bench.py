@@ -230,8 +230,11 @@ def _worker_featurizer() -> dict:
             pa.table({"image": pa.array(structs, type=imageIO.imageSchema)}),
             numPartitions=max(1, n // max(batch, 1)))
 
-    feat = DeepImageFeaturizer(modelName=model_name, inputCol="image",
-                               outputCol="features", batchSize=batch)
+    feat = DeepImageFeaturizer(
+        modelName=model_name, inputCol="image", outputCol="features",
+        batchSize=batch,
+        # bf16 activations on the MXU — the standard TPU inference dtype
+        computeDtype=os.environ.get("BENCH_FEAT_DTYPE", "bfloat16"))
     # Warmup: param init + XLA compile on a small slice.
     feat.transform(make_df(batch)).collect()
 
@@ -242,7 +245,8 @@ def _worker_featurizer() -> dict:
     assert len(out) == rows
     assert len(out[0]["features"]) == feat.featureDim()
     return {"rows_per_sec": rows / dt, "rows": rows, "batch_size": batch,
-            "model": model_name, "wall_s": dt}
+            "model": model_name, "wall_s": dt,
+            "compute_dtype": os.environ.get("BENCH_FEAT_DTYPE", "bfloat16")}
 
 
 _WORKERS = {"resnet50_train": _worker_resnet50_train,

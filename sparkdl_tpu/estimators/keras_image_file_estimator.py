@@ -88,6 +88,8 @@ class KerasImageFileEstimator(PicklesCallableParams, Estimator, HasInputCol,
         loader = self.getOrDefault(self.imageLoader)
         drop_last = self.getOrDefault(self.dropLastBatch)
 
+        from ..transformers.keras_image import loadImageBatch
+
         for _ in range(epochs):
             for rb in dataset.iterBatches(bs):
                 n = rb.num_rows
@@ -95,7 +97,8 @@ class KerasImageFileEstimator(PicklesCallableParams, Estimator, HasInputCol,
                     continue
                 uris = rb.column(in_col).to_pylist()
                 labels = np.asarray(rb.column(label_col).to_pylist())
-                imgs = np.stack([loader(u) for u in uris]).astype(np.float32)
+                # thread-pool decode: every host core loads in parallel
+                imgs = loadImageBatch(loader, uris).astype(np.float32)
                 weight = np.ones((n,), np.float32)
                 if n < bs:
                     pad = bs - n
@@ -172,9 +175,13 @@ class KerasImageFileEstimator(PicklesCallableParams, Estimator, HasInputCol,
         model_state = {"non_trainable": [np.asarray(v.value) for v in
                                          model.non_trainable_variables]}
 
+        # background_iter: batch k+1 decodes on a feeder thread while the
+        # compiled step runs batch k — the fit loop never blocks on decode.
+        from ..core.runtime import background_iter
         res = XlaRunner(np=-1).run(lambda ctx: ctx.fit(
             loss_fn=self._make_loss(model), params=params,
-            tx=self._make_tx(), data=self._batches(dataset, epochs),
+            tx=self._make_tx(),
+            data=background_iter(self._batches(dataset, epochs), maxsize=2),
             num_steps=num_steps, model_state=model_state, mutable=True,
             log_every=max(num_steps // 4, 1)))
 

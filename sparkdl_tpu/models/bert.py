@@ -60,7 +60,7 @@ class BertSelfAttention(nn.Module):
     attn_fn: Any = "auto"
 
     @nn.compact
-    def __call__(self, x, bias, deterministic: bool, mask=None):
+    def __call__(self, x, bias=None, deterministic: bool = True, mask=None):
         c, d = self.cfg, self.dtype
         head_dim = c.hidden_size // c.num_heads
         dense = lambda name: nn.Dense(c.hidden_size, dtype=d, name=name)
@@ -72,15 +72,16 @@ class BertSelfAttention(nn.Module):
         v = split(dense("value")(x))
         from ..ops.flash_attention import resolve_attn_fn
         attn_fn = resolve_attn_fn(self.attn_fn)
-        # The attn_fn path needs the [B, S] mask (padding can't ride the
-        # additive bias through a streaming softmax). Direct 3-arg callers
-        # (x, bias, deterministic) that never pass ``mask`` therefore keep
-        # the dense path — bias is NEVER silently dropped.
-        if attn_fn is not None and mask is None:
+        # attn_fn runs only when the padding state is EXPRESSIBLE to it:
+        # either an explicit [B, S] mask (→ kv_mask), or provably no
+        # padding (bias is None too — the encoder passes bias=None when no
+        # attention_mask was given). A caller supplying only an additive
+        # bias keeps the dense path: the bias is never silently dropped.
+        if attn_fn is not None and mask is None and bias is None:
             # no padding declared: plain (q, k, v, causal=...) contract —
             # ring/Ulysses/dense drop in unchanged
             o = attn_fn(q, k, v, causal=False)
-        elif attn_fn is not None:
+        elif attn_fn is not None and mask is not None:
             import inspect
             try:
                 params = inspect.signature(attn_fn).parameters
@@ -98,7 +99,9 @@ class BertSelfAttention(nn.Module):
             o = attn_fn(q, k, v, causal=False, kv_mask=mask)
         else:
             s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(head_dim)
-            s = s.astype(jnp.float32) + bias  # mask as bias, f32 softmax
+            s = s.astype(jnp.float32)
+            if bias is not None:
+                s = s + bias  # mask as additive bias, f32 softmax
             p = jax.nn.softmax(s, axis=-1).astype(d)
             p = nn.Dropout(c.dropout_rate)(p, deterministic=deterministic)
             o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
@@ -113,7 +116,7 @@ class BertLayer(nn.Module):
     attn_fn: Any = "auto"
 
     @nn.compact
-    def __call__(self, x, bias, deterministic: bool, mask=None):
+    def __call__(self, x, bias=None, deterministic: bool = True, mask=None):
         c, d = self.cfg, self.dtype
         a = BertSelfAttention(c, d, self.attn_fn, name="attention")(
             x, bias, deterministic, mask)
@@ -163,9 +166,12 @@ class BertEncoder(nn.Module):
         x = nn.Dropout(c.dropout_rate)(x, deterministic=deterministic)
         x = x.astype(d)
 
-        # [B, S] mask → additive bias [B, 1, 1, S]
-        bias = (1.0 - attention_mask[:, None, None, :].astype(jnp.float32)) \
-            * -1e30
+        # [B, S] mask → additive bias [B, 1, 1, S]; None when no mask was
+        # given, so the attention layer KNOWS there is no padding (and a
+        # maskless attn_fn is admissible)
+        bias = None if user_mask is None else (
+            (1.0 - attention_mask[:, None, None, :].astype(jnp.float32))
+            * -1e30)
         for i in range(c.num_layers):
             x = BertLayer(c, d, self.attn_fn, name=f"layer_{i}")(
                 x, bias, deterministic, user_mask)

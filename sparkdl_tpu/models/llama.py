@@ -267,6 +267,11 @@ class LlamaModel(nn.Module):
     @nn.compact
     def __call__(self, input_ids, decode: bool = False, pad_lens=None):
         c = self.cfg
+        if pad_lens is not None and not decode:
+            raise ValueError(
+                "pad_lens is a KV-cache serving feature (decode=True); the "
+                "training path has no left-pad masking — feed right-padded "
+                "batches with a loss mask instead")
         S = input_ids.shape[1]
         positions = jnp.arange(S)
         x = nn.Embed(c.vocab_size, c.hidden_size, dtype=self.dtype,

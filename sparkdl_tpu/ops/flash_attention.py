@@ -234,7 +234,7 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, do):
 _flash_core.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, causal: bool = False, kv_mask=None,
+def flash_attention(q, k, v, causal: bool = False, *, kv_mask=None,
                     block_q: int = 128, block_k: int = 128,
                     interpret: bool | None = None):
     """Pallas flash attention. q/k/v: ``[B, H, S, D]`` → ``[B, H, S, D]``.

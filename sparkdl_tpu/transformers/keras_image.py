@@ -19,7 +19,7 @@ from ..core.frame import DataFrame, _length_preserving, _set_column
 from ..core.params import (HasBatchSize, HasInputCol, HasOutputCol, Param,
                            Params, TypeConverters, keyword_only)
 from ..core.pipeline import Transformer
-from ..core.runtime import BatchRunner
+from ..core.runtime import BatchRunner, background_iter
 from .keras_utils import keras_file_to_fn
 from .payloads import BundlesModelFile, PicklesCallableParams
 from .xla_image import arrayColumnToArrow
@@ -34,6 +34,25 @@ def defaultImageLoader(size: tuple[int, int]):
         return np.asarray(img, dtype=np.float32)
 
     return load
+
+
+def loadImageBatch(loader, uris, workers: int = 0) -> np.ndarray:
+    """Decode a URI batch through a thread pool → one stacked NHWC array.
+
+    PIL decode/resize releases the GIL, so a pool of threads keeps every
+    host core decoding (SURVEY.md §7.7 "streams via grain" — the capability
+    is parallel host decode; one Python thread cannot feed a TPU).
+    ``workers=0`` → min(cpu_count, len(uris), 16)."""
+    uris = list(uris)
+    if len(uris) <= 1:
+        return np.stack([loader(u) for u in uris])
+    if workers <= 0:
+        workers = min(os.cpu_count() or 1, len(uris), 16)
+    if workers == 1:
+        return np.stack([loader(u) for u in uris])
+    from concurrent.futures import ThreadPoolExecutor
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        return np.stack(list(pool.map(loader, uris)))
 
 
 class KerasImageFileTransformer(BundlesModelFile, PicklesCallableParams,
@@ -87,11 +106,15 @@ class KerasImageFileTransformer(BundlesModelFile, PicklesCallableParams,
             if batch.num_rows == 0:
                 return _set_column(batch, out_col, emptyVectorColumn())
             uris = batch.column(in_col).to_pylist()
-            # Load lazily per device chunk: decode of chunk k+1 overlaps with
-            # TPU compute on chunk k (prefetch pulls the generator ahead),
-            # and peak host memory is one chunk, not the whole partition.
-            chunks = (np.stack([loader(u) for u in uris[i:i + batch_size]])
-                      for i in range(0, len(uris), batch_size))
+            # Load lazily per device chunk, with the decode itself fanned
+            # over a thread pool AND running one chunk ahead on a feeder
+            # thread (background_iter) — chunk k+1 decodes in parallel
+            # while the TPU computes chunk k; peak host memory is one
+            # chunk + the queue, not the whole partition.
+            chunks = background_iter(
+                (loadImageBatch(loader, uris[i:i + batch_size])
+                 for i in range(0, len(uris), batch_size)),
+                maxsize=runner.prefetch)
             outs = list(runner.run(chunks))
             result = np.concatenate([np.asarray(o) for o in outs], axis=0)
             return _set_column(batch, out_col, arrayColumnToArrow(result))

@@ -39,18 +39,30 @@ class BundlesModelFile:
     MRO note: place before PicklesCallableParams so both payload hooks run
     (each calls super())."""
 
+    _MODEL_EXTS = (".keras", ".h5", ".hdf5")
+
     def _save_payload(self, path: str):
         super()._save_payload(path)
         if self.isDefined("modelFile"):
             import shutil
             src = self.getOrDefault("modelFile")
-            if os.path.exists(src):
-                shutil.copyfile(src, os.path.join(
-                    path, "model" + os.path.splitext(src)[1]))
+            ext = os.path.splitext(src)[1]
+            # Fail LOUDLY here, not at load time on another host: a save()
+            # that silently skips the model file is exactly the
+            # non-durability this mixin exists to prevent.
+            if not os.path.exists(src):
+                raise FileNotFoundError(
+                    f"save(): modelFile {src!r} no longer exists — the "
+                    f"stage cannot be persisted durably")
+            if ext not in self._MODEL_EXTS:
+                raise ValueError(
+                    f"save(): modelFile extension {ext!r} not one of "
+                    f"{self._MODEL_EXTS}; load() would not find the bundle")
+            shutil.copyfile(src, os.path.join(path, "model" + ext))
 
     def _load_payload(self, path: str, meta: dict):
         super()._load_payload(path, meta)
-        for ext in (".keras", ".h5", ".hdf5"):
+        for ext in self._MODEL_EXTS:
             bundled = os.path.join(path, "model" + ext)
             if os.path.exists(bundled):
                 self._set(modelFile=bundled)

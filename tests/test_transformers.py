@@ -311,3 +311,39 @@ def test_array_column_to_arrow_zero_width_and_types():
     arr = arrayColumnToArrow(np.arange(12, dtype=np.float32).reshape(3, 4))
     assert pa.types.is_list(arr.type)
     assert arr.to_pylist()[1] == [4.0, 5.0, 6.0, 7.0]
+
+
+def test_featurizer_bfloat16_compute_close_to_f32():
+    """computeDtype=bfloat16 (the MXU inference dtype) produces features
+    within bf16 tolerance of the f32 path, on the same weights."""
+    df, _ = image_df(n=3, parts=1)
+    f32 = sdl.DeepImageFeaturizer(inputCol="image", outputCol="f",
+                                  modelName="ResNet18", batchSize=4, seed=3)
+    a = np.stack([np.asarray(r.f, np.float32)
+                  for r in f32.transform(df).collect()])
+    bf = sdl.DeepImageFeaturizer(inputCol="image", outputCol="f",
+                                 modelName="ResNet18", batchSize=4, seed=3,
+                                 computeDtype="bfloat16")
+    b = np.stack([np.asarray(r.f, np.float32)
+                  for r in bf.transform(df).collect()])
+    assert b.dtype == np.float32  # features land f32 either way
+    rel = np.abs(a - b) / (np.abs(a) + 1e-3)
+    assert rel.mean() < 0.05, rel.mean()
+
+
+def test_keras_image_parallel_loader_equivalence(tmp_path):
+    """Thread-pool URI loading (loadImageBatch) produces the same batch as
+    the serial path, in order."""
+    from PIL import Image
+    from sparkdl_tpu.transformers.keras_image import loadImageBatch
+    rng = np.random.default_rng(0)
+    uris = []
+    for i in range(7):
+        p = str(tmp_path / f"im{i}.png")
+        Image.fromarray(rng.integers(0, 256, (9, 9, 3), np.uint8)).save(p)
+        uris.append(p)
+    from sparkdl_tpu.transformers.keras_image import defaultImageLoader
+    loader = defaultImageLoader((9, 9))
+    serial = np.stack([loader(u) for u in uris])
+    pooled = loadImageBatch(loader, uris, workers=4)
+    np.testing.assert_array_equal(pooled, serial)
