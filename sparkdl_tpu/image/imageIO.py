@@ -330,18 +330,28 @@ def readImages(path: str, numPartitions: int = 1, dropImageFailures: bool = True
     host memory, never the whole dataset (the BASELINE "batch-scores 1M
     images" north star; round-1 verdict item 4).
     """
+    # decodeImage (PIL) is thread-safe → pooled decode (decodeWorkers=0).
     return readImagesWithCustomFn(path, decode_fn=decodeImage,
                                   numPartitions=numPartitions,
-                                  dropImageFailures=dropImageFailures)
+                                  dropImageFailures=dropImageFailures,
+                                  decodeWorkers=0)
 
 
 def readImagesWithCustomFn(path: str, decode_fn: Callable[[bytes, str], dict | None],
                            numPartitions: int = 1,
-                           dropImageFailures: bool = True):
+                           dropImageFailures: bool = True,
+                           decodeWorkers: int = 1):
+    """``decodeWorkers``: 1 (default) keeps the historical SEQUENTIAL
+    contract — a custom ``decode_fn`` may use shared mutable state. Pass 0
+    (auto: min(cpu_count, 16)) or N>1 to fan decode over a thread pool;
+    ``decode_fn`` must then be thread-safe (the built-in PIL decoder is —
+    ``readImages`` uses the pooled path)."""
     from ..core.frame import DataFrame
     files = _list_image_files(path)
     if not files:
         raise FileNotFoundError(f"No image files under {path!r}")
+    workers = (min(os.cpu_count() or 1, 16) if decodeWorkers == 0
+               else max(1, decodeWorkers))
 
     # Closure counters: the single-process data plane applies ops
     # sequentially, so once every listed file has been seen with zero
@@ -349,21 +359,49 @@ def readImagesWithCustomFn(path: str, decode_fn: Callable[[bytes, str], dict | N
     # "all files failed" error instead of silently yielding 0 rows.
     progress = {"seen": 0, "ok": 0}
 
+    def read_one(uri: str):
+        """Runs on a pool thread (file IO + PIL decode release the GIL);
+        OSError is carried back as a value so ordering/error policy stays
+        on the consumer side."""
+        try:
+            with open(uri, "rb") as fh:
+                return decode_fn(fh.read(), uri)
+        except OSError as e:
+            return e
+
+    pool_holder: list = []  # ONE executor reused across every batch/chunk
+
+    def decode_wave(uris):
+        """Decode up to one wave of URIs, pooled when allowed. Waves are
+        bounded (2×workers) so dropImageFailures=False still fails fast —
+        a bad first file can't trigger the decode of a whole 512-row batch
+        before the error surfaces."""
+        if workers == 1 or len(uris) <= 1:
+            for u in uris:
+                yield u, read_one(u)
+            return
+        if not pool_holder:
+            from concurrent.futures import ThreadPoolExecutor
+            pool_holder.append(ThreadPoolExecutor(max_workers=workers))
+        pool = pool_holder[0]
+        wave = 2 * workers
+        for start in range(0, len(uris), wave):
+            chunk = uris[start:start + wave]
+            yield from zip(chunk, pool.map(read_one, chunk))
+
     def decode_op(batch: pa.RecordBatch) -> pa.RecordBatch:
+        uris = batch.column("_uri").to_pylist()
         structs = []
-        for uri in batch.column("_uri").to_pylist():
+        for uri, s in decode_wave(uris):
             progress["seen"] += 1
-            try:
-                with open(uri, "rb") as fh:
-                    s = decode_fn(fh.read(), uri)
-            except OSError:
+            if isinstance(s, OSError):
                 if dropImageFailures:
                     s = None
                 else:
                     # dropImageFailures=False exists to surface problems:
                     # an unreadable file raises, it does not become a
                     # placeholder row.
-                    raise
+                    raise s
             if s is None:
                 if dropImageFailures:
                     continue

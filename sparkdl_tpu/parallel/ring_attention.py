@@ -101,7 +101,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
                          out_specs=spec, check_vma=False)(q, k, v)
 
 
-def _ulysses_shard(q, k, v, *, axis_name: str, causal: bool):
+def _ulysses_shard(q, k, v, *, axis_name: str, causal: bool, local_attn):
     """Per-shard body: [B, H, T, D] seq-sharded in → seq-sharded out."""
     n = jax.lax.axis_size(axis_name)
 
@@ -116,20 +116,32 @@ def _ulysses_shard(q, k, v, *, axis_name: str, causal: bool):
                                   tiled=True)
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    o = dense_attention(qh, kh, vh, causal=causal)
+    o = local_attn(qh, kh, vh, causal=causal)
     return heads_to_seq(o)
 
 
 def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp",
-                      causal: bool = False):
+                      causal: bool = False, local_attn=None):
     """Ulysses-style sequence parallelism: all_to_all head-scatter /
-    seq-gather, dense attention on local heads, inverse all_to_all.
-    Requires num_heads % axis_size == 0."""
+    seq-gather, attention on local heads over the FULL sequence, inverse
+    all_to_all. Requires num_heads % axis_size == 0.
+
+    ``local_attn``: the per-shard attention over [B, H/n, S, D]. Default
+    ``None`` → dense (materializes an [S, S] score block per local head).
+    Pass ``ops.flash_attention`` (or ``"auto"``: flash on TPU, dense
+    elsewhere) to keep the local compute streaming — at long S this is
+    where the memory goes, so the flash kernel composes with the
+    all-to-all layout exactly as SURVEY §5.7 prescribes.
+    """
     n = mesh.shape[axis]
     if q.shape[1] % n:
         raise ValueError(
             f"num_heads={q.shape[1]} not divisible by {axis}={n}")
-    body = functools.partial(_ulysses_shard, axis_name=axis, causal=causal)
+    if local_attn == "auto":
+        from ..ops.flash_attention import resolve_attn_fn
+        local_attn = resolve_attn_fn("auto")
+    body = functools.partial(_ulysses_shard, axis_name=axis, causal=causal,
+                             local_attn=local_attn or dense_attention)
     spec = P(None, None, axis, None)
     return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)
