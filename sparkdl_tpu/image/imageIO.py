@@ -304,6 +304,23 @@ def nhwcToStructs(batch: np.ndarray, origins: Sequence[str] | None = None,
 
 _IMAGE_EXTENSIONS = {".jpg", ".jpeg", ".png", ".gif", ".bmp", ".webp"}
 
+_POOL = None
+_POOL_LOCK = __import__("threading").Lock()
+
+
+def _decode_pool():
+    """ONE process-wide decode executor shared by every reader DataFrame —
+    a per-reader pool would pin its threads for the reader's lifetime and
+    accumulate across many readImages calls in a long-lived driver."""
+    global _POOL
+    with _POOL_LOCK:
+        if _POOL is None:
+            from concurrent.futures import ThreadPoolExecutor
+            _POOL = ThreadPoolExecutor(
+                max_workers=min(os.cpu_count() or 1, 16),
+                thread_name_prefix="sparkdl-decode")
+        return _POOL
+
 
 def _list_image_files(path: str, recursive: bool = True) -> list[str]:
     if os.path.isfile(path):
@@ -369,8 +386,6 @@ def readImagesWithCustomFn(path: str, decode_fn: Callable[[bytes, str], dict | N
         except OSError as e:
             return e
 
-    pool_holder: list = []  # ONE executor reused across every batch/chunk
-
     def decode_wave(uris):
         """Decode up to one wave of URIs, pooled when allowed. Waves are
         bounded (2×workers) so dropImageFailures=False still fails fast —
@@ -380,10 +395,7 @@ def readImagesWithCustomFn(path: str, decode_fn: Callable[[bytes, str], dict | N
             for u in uris:
                 yield u, read_one(u)
             return
-        if not pool_holder:
-            from concurrent.futures import ThreadPoolExecutor
-            pool_holder.append(ThreadPoolExecutor(max_workers=workers))
-        pool = pool_holder[0]
+        pool = _decode_pool()  # process-wide shared executor (bounded)
         wave = 2 * workers
         for start in range(0, len(uris), wave):
             chunk = uris[start:start + wave]
