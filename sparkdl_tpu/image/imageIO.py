@@ -387,19 +387,33 @@ def readImagesWithCustomFn(path: str, decode_fn: Callable[[bytes, str], dict | N
             return e
 
     def decode_wave(uris):
-        """Decode up to one wave of URIs, pooled when allowed. Waves are
-        bounded (2×workers) so dropImageFailures=False still fails fast —
-        a bad first file can't trigger the decode of a whole 512-row batch
-        before the error surfaces."""
+        """Decode URIs in bounded waves so dropImageFailures=False still
+        fails fast — a bad first file can't trigger the decode of a whole
+        512-row batch before the error surfaces.
+
+        decodeWorkers=0 (auto, the readImages default — thread-safe PIL
+        decode) rides the process-wide shared executor. An EXPLICIT
+        decodeWorkers=N gets a dedicated pool of exactly N threads for
+        this batch (the caller's concurrency contract for decode fns that
+        are only N-thread-safe or memory-budgeted), shut down after.
+        """
         if workers == 1 or len(uris) <= 1:
             for u in uris:
                 yield u, read_one(u)
             return
-        pool = _decode_pool()  # process-wide shared executor (bounded)
-        wave = 2 * workers
-        for start in range(0, len(uris), wave):
-            chunk = uris[start:start + wave]
-            yield from zip(chunk, pool.map(read_one, chunk))
+        if decodeWorkers == 0:
+            pool = _decode_pool()  # shared, min(cpu_count, 16) threads
+            wave = 2 * (os.cpu_count() or 1)
+            for start in range(0, len(uris), wave):
+                chunk = uris[start:start + wave]
+                yield from zip(chunk, pool.map(read_one, chunk))
+            return
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            wave = 2 * workers
+            for start in range(0, len(uris), wave):
+                chunk = uris[start:start + wave]
+                yield from zip(chunk, pool.map(read_one, chunk))
 
     def decode_op(batch: pa.RecordBatch) -> pa.RecordBatch:
         uris = batch.column("_uri").to_pylist()

@@ -301,11 +301,33 @@ def init_cache(model: LlamaModel, batch_size: int, max_len: int):
         lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"])
 
 
-def _sample(logits, key, temperature: float):
+def _sample(logits, key, temperature: float, top_k: int = 0,
+            top_p: float = 1.0):
+    """Greedy (temperature<=0) or temperature sampling with optional
+    top-k / nucleus (top-p) truncation. All branches are static (compiled
+    into the decode program); the filtering is rank-based so shapes stay
+    fixed."""
     if temperature <= 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return jax.random.categorical(
-        key, logits / temperature, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if (top_k and top_k > 0) or top_p < 1.0:
+        # ONE sort serves both filters (this runs inside the per-token
+        # decode scan — a second O(V log V) sort per step is pure waste).
+        sl = jnp.sort(logits, axis=-1)[..., ::-1]  # descending
+        if top_k and top_k > 0:
+            ranks = jnp.arange(sl.shape[-1])
+            sl = jnp.where(ranks < top_k, sl, -jnp.inf)
+        if top_p < 1.0:
+            probs = jax.nn.softmax(sl, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            # keep the smallest prefix with cumulative prob >= top_p
+            # (rank 0 always kept: cum - probs is 0 there)
+            sl = jnp.where(cum - probs < top_p, sl, -jnp.inf)
+        # cutoff = smallest surviving logit; ties at the cutoff stay in
+        cutoff = jnp.min(jnp.where(jnp.isfinite(sl), sl, jnp.inf),
+                         axis=-1, keepdims=True)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
 @functools.partial(jax.jit, static_argnames=("model",))
@@ -321,14 +343,16 @@ def _prefill(model, params, prompt_ids, cache, pad_lens=None):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("model", "max_new_tokens", "temperature"))
+    jax.jit, static_argnames=("model", "max_new_tokens", "temperature",
+                              "top_k", "top_p"))
 def _decode(model, params, cache, last_logits, rng, pad_lens=None, *,
-            max_new_tokens: int, temperature: float):
+            max_new_tokens: int, temperature: float, top_k: int = 0,
+            top_p: float = 1.0):
     """lax.scan: one token per step. Compiled per (batch, max_len)
     signature — independent of the prompt length, so varying-length prompts
     with a shared cache size reuse ONE decode program."""
     rng, key = jax.random.split(rng)
-    tok = _sample(last_logits, key, temperature)
+    tok = _sample(last_logits, key, temperature, top_k, top_p)
 
     # each step emits the already-sampled token and samples the next; after
     # n steps the emitted sequence is exactly the n new tokens
@@ -338,7 +362,8 @@ def _decode(model, params, cache, last_logits, rng, pad_lens=None, *,
                                   tok[:, None], decode=True,
                                   pad_lens=pad_lens, mutable=["cache"])
         rng, key = jax.random.split(rng)
-        nxt = _sample(logits[:, -1].astype(jnp.float32), key, temperature)
+        nxt = _sample(logits[:, -1].astype(jnp.float32), key, temperature,
+                      top_k, top_p)
         return (mut["cache"], nxt, rng), tok
 
     _, toks = jax.lax.scan(
@@ -366,7 +391,7 @@ _warned_attn_fn_ignored = False
 
 def generate(model: LlamaModel, variables, prompt_ids, max_new_tokens: int,
              temperature: float = 0.0, rng=None, pad_to: int | None = None,
-             pad_lens=None):
+             pad_lens=None, top_k: int = 0, top_p: float = 1.0):
     """Greedy / temperature sampling with a KV cache.
 
     Two jitted programs: a prefill pass writes the prompt's cache in a
@@ -392,6 +417,11 @@ def generate(model: LlamaModel, variables, prompt_ids, max_new_tokens: int,
             "dense cache attention (sequence-parallel serving is a future "
             "cache-aware kernel)")
         _warned_attn_fn_ignored = True
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p} — 0 would "
+                         f"mask every token and degenerate to id 0")
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0 (0 disables), got {top_k}")
     prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
     b, lp = prompt_ids.shape
     if lp < 1:
@@ -409,7 +439,8 @@ def generate(model: LlamaModel, variables, prompt_ids, max_new_tokens: int,
     last_logits, cache = _prefill(model, params, prompt_ids, cache, pad_lens)
     toks = _decode(model, params, cache, last_logits, rng, pad_lens,
                    max_new_tokens=int(max_new_tokens),
-                   temperature=float(temperature))
+                   temperature=float(temperature), top_k=int(top_k),
+                   top_p=float(top_p))
     return jnp.concatenate([prompt_ids, toks], axis=1)
 
 

@@ -87,7 +87,8 @@ def registerKerasImageUDF(udf_name: str, keras_model_or_file,
 def registerGenerationUDF(name: str, model, variables,
                           max_new_tokens: int = 32,
                           temperature: float = 0.0, seed: int = 0,
-                          batchRows: int = 64) -> None:
+                          batchRows: int = 64, top_k: int = 0,
+                          top_p: float = 1.0) -> None:
     """Register a text-generation UDF over token-id columns — the
     ``registerUDF`` batch-inference half of BASELINE config 5 ("Llama LoRA
     fine-tune via XlaRunner + registerUDF batch inference").
@@ -105,6 +106,12 @@ def registerGenerationUDF(name: str, model, variables,
     import numpy as np
 
     from ..models.llama import generate, left_pad_prompts
+
+    # fail at REGISTRATION, not on the first applyUDF call
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0 (0 disables), got {top_k}")
 
     def apply(df: DataFrame, inputCol: str, outputCol: str) -> DataFrame:
         import pandas as pd
@@ -137,7 +144,8 @@ def registerGenerationUDF(name: str, model, variables,
                 gen = np.asarray(generate(
                     model, variables, ids, max_new_tokens,
                     temperature=temperature, rng=key,
-                    pad_to=lmax + max_new_tokens, pad_lens=pads))
+                    pad_to=lmax + max_new_tokens, pad_lens=pads,
+                    top_k=top_k, top_p=top_p))
                 for row in range(n):
                     # strip this row's left pads: real prompt + new tokens
                     out[start + row] = gen[row, pads[row]:].tolist()

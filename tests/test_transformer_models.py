@@ -436,3 +436,54 @@ def test_bert_maskless_attn_fn_contract():
                                rtol=2e-4, atol=2e-4)
     with pytest.raises(TypeError, match="kv_mask"):
         m.apply(v, ids, np.ones((2, 16), np.int32))
+
+
+def test_sampling_top_k_top_p():
+    """top-k restricts sampling to the k best logits; top-p to the nucleus.
+    Distribution-level check on the _sample primitive (compiled shapes are
+    static; filtering is rank-based)."""
+    from sparkdl_tpu.models.llama import _sample
+    logits = jnp.asarray(np.log(np.array(
+        [[0.5, 0.3, 0.15, 0.04, 0.01]], np.float32)))
+    keys = jax.random.split(jax.random.PRNGKey(0), 300)
+    # top_k=2: only ids {0,1} can appear
+    toks = np.asarray([_sample(logits, k, 1.0, 2, 1.0)[0] for k in keys[:50]])
+    assert set(toks.tolist()) <= {0, 1}
+    # top_p=0.75: nucleus {0,1} (0.5 < 0.75, 0.5+0.3 >= 0.75; off the
+    # exact cumulative boundaries so f32 rounding can't flip membership)
+    toks = np.asarray([_sample(logits, k, 1.0, 0, 0.75)[0]
+                       for k in keys[:50]])
+    assert set(toks.tolist()) <= {0, 1}
+    # top_p=0.9: {0,1,2} (0.8 < 0.9 <= 0.95)
+    toks = np.asarray([_sample(logits, k, 1.0, 0, 0.9)[0]
+                       for k in keys])
+    assert set(toks.tolist()) <= {0, 1, 2} and 2 in set(toks.tolist())
+    # greedy ignores both
+    assert int(_sample(logits, keys[0], 0.0, 2, 0.5)[0]) == 0
+
+
+def test_generate_with_sampling_args():
+    from sparkdl_tpu.models.llama import LlamaConfig, LlamaModel, generate
+    cfg = LlamaConfig.tiny()
+    model = LlamaModel(cfg)
+    ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 6)).astype(np.int32)
+    v = model.init(jax.random.PRNGKey(0), ids)
+    out = generate(model, v, ids, 4, temperature=0.8, top_k=10, top_p=0.9,
+                   rng=jax.random.PRNGKey(1))
+    assert out.shape == (2, 10)
+    assert (np.asarray(out[:, :6]) == ids).all()
+
+
+def test_sampling_validation():
+    from sparkdl_tpu.models.llama import LlamaConfig, LlamaModel, generate
+    from sparkdl_tpu.udf import registerGenerationUDF
+    cfg = LlamaConfig.tiny()
+    model = LlamaModel(cfg)
+    v = model.init(jax.random.PRNGKey(0), np.zeros((1, 4), np.int32))
+    with pytest.raises(ValueError, match="top_p"):
+        generate(model, v, np.ones((1, 3), np.int32), 2, temperature=0.5,
+                 top_p=0.0)
+    with pytest.raises(ValueError, match="top_k"):
+        generate(model, v, np.ones((1, 3), np.int32), 2, top_k=-1)
+    with pytest.raises(ValueError, match="top_p"):
+        registerGenerationUDF("bad", model, v, top_p=0.0)
