@@ -194,31 +194,29 @@ def background_iter(iterator: Iterable, maxsize: int = 2) -> Iterator:
     cancelled = threading.Event()
     failure: list[BaseException] = []
 
+    def put_bounded(item) -> bool:
+        """Put with cancellation polling — a cancelled consumer can't
+        strand the producer on a full queue. True iff delivered."""
+        while not cancelled.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue_mod.Full:
+                continue
+        return False
+
     def work():
         try:
             for item in iterator:
-                # Bounded-wait put so a cancelled consumer can't strand us
-                # on a full queue.
-                while not cancelled.is_set():
-                    try:
-                        q.put(item, timeout=0.1)
-                        break
-                    except queue_mod.Full:
-                        continue
-                if cancelled.is_set():
+                if not put_bounded(item):
                     return
         except BaseException as e:  # noqa: BLE001 — re-raised in consumer
             failure.append(e)
         finally:
             # The sentinel must actually arrive while the consumer lives —
             # dropping it on a transiently-full queue would strand the
-            # consumer in q.get(). Same bounded-wait as the items.
-            while not cancelled.is_set():
-                try:
-                    q.put(sentinel, timeout=0.1)
-                    break
-                except queue_mod.Full:
-                    continue
+            # consumer in q.get().
+            put_bounded(sentinel)
 
     threading.Thread(target=work, daemon=True,
                      name="sparkdl-feed").start()

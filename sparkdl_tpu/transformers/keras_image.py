@@ -42,14 +42,15 @@ def loadImageBatch(loader, uris, workers: int = 0) -> np.ndarray:
     PIL decode/resize releases the GIL, so a pool of threads keeps every
     host core decoding (SURVEY.md §7.7 "streams via grain" — the capability
     is parallel host decode; one Python thread cannot feed a TPU).
-    ``workers=0`` → min(cpu_count, len(uris), 16)."""
+    ``workers=0`` (auto) rides the process-wide shared decode executor
+    (imageIO — no per-batch thread churn); an explicit N gets a dedicated
+    N-thread pool for this batch (for loaders only N-thread-safe)."""
     uris = list(uris)
-    if len(uris) <= 1:
+    if len(uris) <= 1 or workers == 1:
         return np.stack([loader(u) for u in uris])
     if workers <= 0:
-        workers = min(os.cpu_count() or 1, len(uris), 16)
-    if workers == 1:
-        return np.stack([loader(u) for u in uris])
+        from ..image.imageIO import _decode_pool
+        return np.stack(list(_decode_pool().map(loader, uris)))
     from concurrent.futures import ThreadPoolExecutor
     with ThreadPoolExecutor(max_workers=workers) as pool:
         return np.stack(list(pool.map(loader, uris)))
