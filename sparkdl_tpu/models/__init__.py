@@ -4,8 +4,8 @@ from .llama import (LlamaConfig, LlamaModel, causal_lm_loss_fn, lora_mask,
                     lora_optimizer)
 from .pretrained import (CheckpointMismatch, import_hf_bert, import_hf_llama,
                          import_keras_inception, import_keras_resnet,
-                         import_keras_vgg, load_pretrained,
-                         merge_into_template, read_keras_h5)
+                         import_keras_vgg, import_keras_xception,
+                         load_pretrained, merge_into_template, read_keras_h5)
 from .registry import (SUPPORTED_MODELS, NamedImageModel, decodePredictions,
                        get_model, load_safetensors, load_weights,
                        preprocess_caffe, preprocess_tf, preprocess_torch,
@@ -21,5 +21,6 @@ __all__ = [
     "lora_optimizer",
     "load_pretrained", "import_hf_llama", "import_hf_bert",
     "import_keras_resnet", "import_keras_vgg", "import_keras_inception",
+    "import_keras_xception",
     "read_keras_h5", "merge_into_template", "CheckpointMismatch",
 ]

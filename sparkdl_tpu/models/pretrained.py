@@ -16,9 +16,11 @@ pytrees for the model zoo"). Here the supported foreign layouts are:
 - **Keras-layout ``.h5``** (both the legacy ``layer_names`` topological
   format of the published keras-applications ImageNet files and the
   Keras-3 ``.weights.h5`` format) for the image zoo → ``models/resnet.py``
-  / ``vgg.py`` / ``inception.py`` trees. Conv biases present in keras
-  ResNet files are folded into the following BatchNorm's moving mean
-  (exact under eval-mode BN; a bias preceding train-mode BN is a no-op).
+  / ``vgg.py`` / ``inception.py`` / ``xception.py`` trees. Conv biases
+  present in keras ResNet files are folded into the following BatchNorm's
+  moving mean (exact under eval-mode BN; a bias preceding train-mode BN
+  is a no-op); separable convs transpose keras' (h,w,in,1) depthwise
+  kernels to flax's (h,w,1,in).
 
 Everything runs offline on locally-provided files (zero-egress
 environment); tests generate foreign-named checkpoints with the installed
@@ -504,6 +506,101 @@ def import_keras_inception(path: str, template: dict) -> dict:
     return out
 
 
+def _keras_sepconv(layers: Mapping[str, list], sep_name: str,
+                   bn_name: str):
+    """One keras SeparableConv2D(+BN) → this repo's SeparableConvBN leaves.
+
+    Keras stores [depthwise_kernel (h,w,in,1), pointwise_kernel] in ONE
+    layer; flax's grouped-conv depthwise kernel is (h,w,1,in) — transpose
+    the last two axes."""
+    if sep_name not in layers:
+        raise CheckpointMismatch(f"Keras file has no layer {sep_name!r}")
+    w = layers[sep_name]
+    if len(w) != 2:
+        raise CheckpointMismatch(
+            f"{sep_name}: expected [depthwise, pointwise], got {len(w)} "
+            f"arrays (biased separable convs are not part of this layout)")
+    dw = np.transpose(np.asarray(w[0]), (0, 1, 3, 2))
+    pw = np.asarray(w[1])
+    bw = list(layers.get(bn_name, ()))
+    if len(bw) != 4:
+        raise CheckpointMismatch(f"{bn_name}: expected 4 BN arrays")
+    gamma, beta, mean, var = (np.asarray(a) for a in bw)
+    return ({"depthwise": {"kernel": dw}, "pointwise": {"kernel": pw},
+             "bn": {"scale": gamma, "bias": beta}},
+            {"bn": {"mean": mean, "var": var}})
+
+
+def import_keras_xception(path: str, template: dict) -> dict:
+    """Keras-layout Xception ``.h5`` → ``models/xception.py`` tree.
+
+    Named layers (block{i}_sepconv{j}, block1_conv{1,2}) map directly; the
+    four residual 1x1 convs are auto-named (conv2d[_N]) and map by creation
+    order: entry1, entry2, entry3, exit projections.
+    """
+    layers = read_keras_h5(path)
+    params: dict = {}
+    stats: dict = {}
+
+    for i in (1, 2):
+        conv, bn, st = _keras_convbn(layers, f"block1_conv{i}",
+                                     f"block1_conv{i}_bn")
+        params[f"stem_conv{i}"] = conv
+        params[f"stem_bn{i}"] = bn
+        stats[f"stem_bn{i}"] = st
+
+    def sep_into(block: dict, bstats: dict, key: str, kname: str):
+        p, s = _keras_sepconv(layers, kname, kname + "_bn")
+        block[key] = p
+        bstats[key] = s
+
+    for i in (1, 2, 3):  # entry blocks ← keras block2..4
+        bp: dict = {}
+        bs: dict = {}
+        for j in (1, 2):
+            sep_into(bp, bs, f"sep{j}", f"block{i + 1}_sepconv{j}")
+        params[f"entry{i}"], stats[f"entry{i}"] = bp, bs
+    for i in range(1, 9):  # middle blocks ← keras block5..12
+        for j in (1, 2, 3):
+            p, s = _keras_sepconv(layers, f"block{i + 4}_sepconv{j}",
+                                  f"block{i + 4}_sepconv{j}_bn")
+            params[f"middle{i}_sep{j}"] = p
+            stats[f"middle{i}_sep{j}"] = s
+    for key, kname in (("exit_sep1", "block13_sepconv1"),
+                       ("exit_sep2", "block13_sepconv2"),
+                       ("exit_sep3", "block14_sepconv1"),
+                       ("exit_sep4", "block14_sepconv2")):
+        p, s = _keras_sepconv(layers, kname, kname + "_bn")
+        params[key] = p
+        stats[key] = s
+
+    # residual projections: auto-named conv2d[_N]/batch_normalization[_N],
+    # creation order = entry1, entry2, entry3, exit
+    convs = _numbered(layers, "conv2d")
+    bns = _numbered(layers, "batch_normalization")
+    if len(convs) != 4 or len(bns) != 4:
+        raise CheckpointMismatch(
+            f"Xception expects 4 auto-named residual conv/bn pairs, file "
+            f"has {len(convs)}/{len(bns)}")
+    for block, cname, bname in zip(
+            ["entry1", "entry2", "entry3", None], convs, bns):
+        conv, bn, st = _keras_convbn(layers, cname, bname)
+        if block is None:  # the exit-flow projection is flat-named
+            params["exit_proj_conv"], params["exit_proj_bn"] = conv, bn
+            stats["exit_proj_bn"] = st
+        else:
+            params[block]["proj_conv"] = conv
+            params[block]["proj_bn"] = bn
+            stats[block]["proj_bn"] = st
+
+    if "head" in template.get("params", {}):
+        params["head"] = _keras_dense(
+            layers, "predictions" if "predictions" in layers else "head")
+    out = {"params": params, "batch_stats": stats}
+    _check_tree_shapes(out, template, "keras Xception")
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Dispatch
 # ---------------------------------------------------------------------------
@@ -541,9 +638,11 @@ def load_pretrained(model_name: str, path: str, *, cfg=None,
             return import_keras_vgg(path, template)
         if lname.startswith("inception"):
             return import_keras_inception(path, template)
+        if lname.startswith("xception"):
+            return import_keras_xception(path, template)
         raise CheckpointMismatch(
-            f"No Keras .h5 importer for {model_name!r} "
-            f"(supported: ResNet50/101/152, VGG16/19, InceptionV3)")
+            f"No Keras .h5 importer for {model_name!r} (supported: "
+            f"ResNet50/101/152, VGG16/19, InceptionV3, Xception)")
     if template is None:
         template = registry.get_model(model_name).init_params()
     if path.endswith(".safetensors"):

@@ -69,12 +69,14 @@ class Xception(nn.Module):
         norm = lambda name: nn.BatchNorm(use_running_average=not train,
                                          momentum=0.99, epsilon=1e-3,
                                          dtype=self.dtype, name=name)
-        # Entry flow
-        x = nn.Conv(32, (3, 3), strides=(2, 2), use_bias=False,
-                    dtype=self.dtype, name="stem_conv1")(x)
+        # Entry flow. VALID stem padding — the paper's (and keras-
+        # applications') convention, so imported keras weights see the
+        # exact spatial grid they were trained on (models/pretrained.py).
+        x = nn.Conv(32, (3, 3), strides=(2, 2), padding="VALID",
+                    use_bias=False, dtype=self.dtype, name="stem_conv1")(x)
         x = nn.relu(norm("stem_bn1")(x))
-        x = nn.Conv(64, (3, 3), use_bias=False, dtype=self.dtype,
-                    name="stem_conv2")(x)
+        x = nn.Conv(64, (3, 3), padding="VALID", use_bias=False,
+                    dtype=self.dtype, name="stem_conv2")(x)
         x = nn.relu(norm("stem_bn2")(x))
         x = XceptionBlock(128, strides=2, relu_first=False, dtype=self.dtype,
                           name="entry1")(x, train)

@@ -10,8 +10,6 @@ on the TPU (the BatchRunner prefetch overlap).
 
 from __future__ import annotations
 
-import os
-
 import numpy as np
 import pyarrow as pa
 

@@ -387,3 +387,28 @@ def test_featurizer_with_keras_h5_weights(tmp_path):
     want = np.asarray(feat_keras(np.asarray(preprocess_caffe(x)),
                                  training=False))
     np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.slow
+def test_import_keras_xception_forward_equivalence(tmp_path):
+    keras = _keras()
+    from sparkdl_tpu.models import xception
+
+    km = keras.applications.Xception(weights=None,
+                                     classifier_activation=None)
+    f = str(tmp_path / "xc.h5")
+    km.save(f)
+
+    template = jax.tree_util.tree_map(
+        lambda s: np.zeros(s.shape, s.dtype),
+        jax.eval_shape(lambda: xception.Xception(num_classes=1000).init(
+            jax.random.PRNGKey(0), np.zeros((1, 299, 299, 3), np.float32),
+            train=False)))
+    variables = load_pretrained("Xception", f, template=template)
+
+    x = np.random.RandomState(2).uniform(
+        -1, 1, (1, 299, 299, 3)).astype(np.float32)
+    want = np.asarray(km(x, training=False))
+    got = np.asarray(xception.Xception(num_classes=1000).apply(
+        variables, x, train=False))
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
