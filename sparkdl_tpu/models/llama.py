@@ -28,6 +28,7 @@ from typing import Any, Callable, Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 @dataclasses.dataclass(frozen=True)
@@ -344,30 +345,41 @@ def _prefill(model, params, prompt_ids, cache, pad_lens=None):
 
 @functools.partial(
     jax.jit, static_argnames=("model", "max_new_tokens", "temperature",
-                              "top_k", "top_p"))
+                              "top_k", "top_p", "eos_id"))
 def _decode(model, params, cache, last_logits, rng, pad_lens=None, *,
             max_new_tokens: int, temperature: float, top_k: int = 0,
-            top_p: float = 1.0):
+            top_p: float = 1.0, eos_id: int | None = None):
     """lax.scan: one token per step. Compiled per (batch, max_len)
     signature — independent of the prompt length, so varying-length prompts
-    with a shared cache size reuse ONE decode program."""
+    with a shared cache size reuse ONE decode program.
+
+    ``eos_id``: rows that emit it keep emitting it for the remaining steps
+    (static shapes — the scan always runs max_new_tokens; finished rows
+    just stop changing, and callers strip the eos tail)."""
     rng, key = jax.random.split(rng)
     tok = _sample(last_logits, key, temperature, top_k, top_p)
+    # eos_id is static, so the eos-free default compiles the exact
+    # pre-eos program: no dead done array rides the scan carry
+    carry0 = ((cache, tok, rng) if eos_id is None
+              else (cache, tok, rng, tok == eos_id))
 
     # each step emits the already-sampled token and samples the next; after
     # n steps the emitted sequence is exactly the n new tokens
     def step(carry, _):
-        cache, tok, rng = carry
+        cache, tok, rng = carry[:3]
         logits, mut = model.apply({"params": params, "cache": cache},
                                   tok[:, None], decode=True,
                                   pad_lens=pad_lens, mutable=["cache"])
         rng, key = jax.random.split(rng)
         nxt = _sample(logits[:, -1].astype(jnp.float32), key, temperature,
                       top_k, top_p)
-        return (mut["cache"], nxt, rng), tok
+        if eos_id is None:
+            return (mut["cache"], nxt, rng), tok
+        done = carry[3]
+        nxt = jnp.where(done, eos_id, nxt)
+        return (mut["cache"], nxt, rng, done | (nxt == eos_id)), tok
 
-    _, toks = jax.lax.scan(
-        step, (cache, tok, rng), None, length=max_new_tokens)
+    _, toks = jax.lax.scan(step, carry0, None, length=max_new_tokens)
     return jnp.moveaxis(toks, 0, 1)
 
 
@@ -391,7 +403,8 @@ _warned_attn_fn_ignored = False
 
 def generate(model: LlamaModel, variables, prompt_ids, max_new_tokens: int,
              temperature: float = 0.0, rng=None, pad_to: int | None = None,
-             pad_lens=None, top_k: int = 0, top_p: float = 1.0):
+             pad_lens=None, top_k: int = 0, top_p: float = 1.0,
+             eos_id: int | None = None):
     """Greedy / temperature sampling with a KV cache.
 
     Two jitted programs: a prefill pass writes the prompt's cache in a
@@ -422,6 +435,10 @@ def generate(model: LlamaModel, variables, prompt_ids, max_new_tokens: int,
                          f"mask every token and degenerate to id 0")
     if top_k < 0:
         raise ValueError(f"top_k must be >= 0 (0 disables), got {top_k}")
+    if eos_id is not None and (isinstance(eos_id, bool)
+                               or not isinstance(eos_id, (int, np.integer))):
+        raise TypeError(f"eos_id must be an int token id or None, "
+                        f"got {eos_id!r}")
     prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
     b, lp = prompt_ids.shape
     if lp < 1:
@@ -440,7 +457,8 @@ def generate(model: LlamaModel, variables, prompt_ids, max_new_tokens: int,
     toks = _decode(model, params, cache, last_logits, rng, pad_lens,
                    max_new_tokens=int(max_new_tokens),
                    temperature=float(temperature), top_k=int(top_k),
-                   top_p=float(top_p))
+                   top_p=float(top_p),
+                   eos_id=None if eos_id is None else int(eos_id))
     return jnp.concatenate([prompt_ids, toks], axis=1)
 
 

@@ -88,7 +88,8 @@ def registerGenerationUDF(name: str, model, variables,
                           max_new_tokens: int = 32,
                           temperature: float = 0.0, seed: int = 0,
                           batchRows: int = 64, top_k: int = 0,
-                          top_p: float = 1.0) -> None:
+                          top_p: float = 1.0,
+                          eos_id: int | None = None) -> None:
     """Register a text-generation UDF over token-id columns — the
     ``registerUDF`` batch-inference half of BASELINE config 5 ("Llama LoRA
     fine-tune via XlaRunner + registerUDF batch inference").
@@ -112,6 +113,10 @@ def registerGenerationUDF(name: str, model, variables,
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if top_k < 0:
         raise ValueError(f"top_k must be >= 0 (0 disables), got {top_k}")
+    if eos_id is not None and (isinstance(eos_id, bool)
+                               or not isinstance(eos_id, (int, np.integer))):
+        raise TypeError(f"eos_id must be an int token id or None, "
+                        f"got {eos_id!r}")
 
     def apply(df: DataFrame, inputCol: str, outputCol: str) -> DataFrame:
         import pandas as pd
@@ -145,10 +150,18 @@ def registerGenerationUDF(name: str, model, variables,
                     model, variables, ids, max_new_tokens,
                     temperature=temperature, rng=key,
                     pad_to=lmax + max_new_tokens, pad_lens=pads,
-                    top_k=top_k, top_p=top_p))
+                    top_k=top_k, top_p=top_p, eos_id=eos_id))
                 for row in range(n):
                     # strip this row's left pads: real prompt + new tokens
-                    out[start + row] = gen[row, pads[row]:].tolist()
+                    toks = gen[row, pads[row]:].tolist()
+                    if eos_id is not None:
+                        # trim the repeated-eos tail, keep one eos
+                        plen = len(prompts[start + row])
+                        gen_part = toks[plen:]
+                        if eos_id in gen_part:
+                            gen_part = gen_part[:gen_part.index(eos_id) + 1]
+                        toks = toks[:plen] + gen_part
+                    out[start + row] = toks
         pdf = pdf.copy()
         pdf[outputCol] = pd.Series(out, index=pdf.index)
         return DataFrame.fromPandas(pdf, numPartitions=df.numPartitions)

@@ -487,3 +487,33 @@ def test_sampling_validation():
         generate(model, v, np.ones((1, 3), np.int32), 2, top_k=-1)
     with pytest.raises(ValueError, match="top_p"):
         registerGenerationUDF("bad", model, v, top_p=0.0)
+    with pytest.raises(TypeError, match="eos_id"):
+        registerGenerationUDF("bad", model, v, eos_id="</s>")
+
+
+def test_generation_eos_stops_rows():
+    """Rows that emit eos keep emitting it (static shapes); the UDF trims
+    the tail to one eos."""
+    from sparkdl_tpu.models.llama import LlamaConfig, LlamaModel, generate
+    import sparkdl_tpu as sdl
+    from sparkdl_tpu.udf import registerGenerationUDF, unregisterUDF
+
+    cfg = LlamaConfig.tiny()
+    model = LlamaModel(cfg)
+    ids = np.asarray([[1, 2, 3]], np.int32)
+    v = model.init(jax.random.PRNGKey(0), ids)
+    # find what greedy emits first, then use THAT id as eos: the row is
+    # done immediately and every subsequent token must equal eos
+    free = np.asarray(generate(model, v, ids, 5))
+    eos = int(free[0, 3])
+    out = np.asarray(generate(model, v, ids, 5, eos_id=eos))
+    assert (out[0, 3:] == eos).all()
+
+    df = sdl.DataFrame.fromPydict({"p": [[1, 2, 3]]})
+    registerGenerationUDF("eos_g", model, v, max_new_tokens=5, eos_id=eos)
+    try:
+        res = sdl.applyUDF(df, "eos_g", "p", "c").toPandas()
+    finally:
+        unregisterUDF("eos_g")
+    c = list(res["c"][0])
+    assert c == [1, 2, 3, eos]  # trimmed to one eos after the prompt
