@@ -98,7 +98,8 @@ def _device_grid(devs: list, sizes: list[int]) -> np.ndarray:
     aware axis assignment). Single device, CPU, or anything mesh_utils
     can't place (virtual topologies) → row-major reshape, which is exactly
     what the torus-aware path degenerates to there anyway."""
-    if len(devs) > 1 and getattr(devs[0], "platform", "") == "tpu":
+    from sparkdl_tpu.utils.platform import is_tpu_device
+    if len(devs) > 1 and is_tpu_device(devs[0]):
         try:
             from jax.experimental import mesh_utils
             return mesh_utils.create_device_mesh(sizes, devices=devs)

@@ -57,6 +57,14 @@ class LlamaConfig:
                    num_heads=4, num_kv_heads=2, intermediate_size=256,
                    rope_theta=10000.0, lora_rank=lora_rank)
 
+    @classmethod
+    def small(cls, lora_rank: int = 0) -> "LlamaConfig":
+        """~1B-class config (TinyLlama-shaped) — fits one v5e chip with KV
+        cache; the single-chip serving-bench model."""
+        return cls(vocab_size=32000, hidden_size=2048, num_layers=16,
+                   num_heads=16, num_kv_heads=8, intermediate_size=5632,
+                   rope_theta=10000.0, lora_rank=lora_rank)
+
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_heads
@@ -349,49 +357,79 @@ def _prefill(model, params, prompt_ids, cache, pad_lens=None):
 def _decode(model, params, cache, last_logits, rng, pad_lens=None, *,
             max_new_tokens: int, temperature: float, top_k: int = 0,
             top_p: float = 1.0, eos_id: int | None = None):
-    """lax.scan: one token per step. Compiled per (batch, max_len)
-    signature — independent of the prompt length, so varying-length prompts
-    with a shared cache size reuse ONE decode program.
+    """One token per step; compiled per (batch, max_len) signature —
+    independent of the prompt length, so varying-length prompts with a
+    shared cache size reuse ONE decode program.
 
-    ``eos_id``: rows that emit it keep emitting it for the remaining steps
-    (static shapes — the scan always runs max_new_tokens; finished rows
-    just stop changing, and callers strip the eos tail)."""
+    Without ``eos_id``: a ``lax.scan`` of exactly max_new_tokens steps.
+    With ``eos_id``: a ``lax.while_loop`` that STOPS as soon as every row
+    has emitted eos — an all-done batch pays only the steps it used, not
+    max_new_tokens (round-3 verdict Next #6: compute-side early exit, not
+    just host-side tail trimming). Unwritten output slots hold eos_id,
+    which is exactly what the fixed-length scan emitted for done rows.
+
+    Returns ``(tokens [B, max_new_tokens], n_steps)`` where n_steps is the
+    number of decode-loop iterations actually executed (== max_new_tokens
+    for the scan path)."""
     rng, key = jax.random.split(rng)
     tok = _sample(last_logits, key, temperature, top_k, top_p)
-    # eos_id is static, so the eos-free default compiles the exact
-    # pre-eos program: no dead done array rides the scan carry
-    carry0 = ((cache, tok, rng) if eos_id is None
-              else (cache, tok, rng, tok == eos_id))
 
-    # each step emits the already-sampled token and samples the next; after
-    # n steps the emitted sequence is exactly the n new tokens
-    def step(carry, _):
-        cache, tok, rng = carry[:3]
+    def model_step(cache, tok, rng):
         logits, mut = model.apply({"params": params, "cache": cache},
                                   tok[:, None], decode=True,
                                   pad_lens=pad_lens, mutable=["cache"])
         rng, key = jax.random.split(rng)
         nxt = _sample(logits[:, -1].astype(jnp.float32), key, temperature,
                       top_k, top_p)
-        if eos_id is None:
-            return (mut["cache"], nxt, rng), tok
-        done = carry[3]
+        return mut["cache"], nxt, rng
+
+    if eos_id is None:
+        # each step emits the already-sampled token and samples the next;
+        # after n steps the emitted sequence is exactly the n new tokens
+        def step(carry, _):
+            cache, nxt, rng = model_step(*carry)
+            return (cache, nxt, rng), carry[1]
+
+        _, toks = jax.lax.scan(step, (cache, tok, rng), None,
+                               length=max_new_tokens)
+        return jnp.moveaxis(toks, 0, 1), jnp.asarray(max_new_tokens)
+
+    out0 = jnp.full((tok.shape[0], max_new_tokens), eos_id, jnp.int32)
+
+    def cond(carry):
+        _, _, _, done, i, _ = carry
+        return (i < max_new_tokens) & ~jnp.all(done)
+
+    def body(carry):
+        cache, tok, rng, done, i, out = carry
+        out = out.at[:, i].set(tok)
+        cache, nxt, rng = model_step(cache, tok, rng)
         nxt = jnp.where(done, eos_id, nxt)
-        return (mut["cache"], nxt, rng, done | (nxt == eos_id)), tok
+        return (cache, nxt, rng, done | (nxt == eos_id), i + 1, out)
 
-    _, toks = jax.lax.scan(step, carry0, None, length=max_new_tokens)
-    return jnp.moveaxis(toks, 0, 1)
+    carry = jax.lax.while_loop(
+        cond, body,
+        (cache, tok, rng, tok == eos_id, jnp.asarray(0), out0))
+    return carry[5], carry[4]
 
 
-def left_pad_prompts(prompts, pad_id: int = 0):
+def left_pad_prompts(prompts, pad_id: int = 0, pad_to: int | None = None):
     """Variable-length prompt lists → (ids [B, Lmax] left-padded, pad_lens
     [B]). Left padding keeps every row's newest token at the last position,
-    so one prefill program + one decode program serve mixed lengths."""
+    so one prefill program + one decode program serve mixed lengths.
+
+    ``pad_to`` pins Lmax externally — chunked callers (the streaming
+    generation UDF) pass the column-wide max so every chunk shares one
+    compiled (rows, Lmax) signature."""
     import numpy as np
     lens = [len(p) for p in prompts]
     if min(lens, default=0) < 1:
         raise ValueError("every prompt needs at least one token id")
     lmax = max(lens)
+    if pad_to is not None:
+        if pad_to < lmax:
+            raise ValueError(f"pad_to={pad_to} < longest prompt {lmax}")
+        lmax = pad_to
     ids = np.full((len(prompts), lmax), pad_id, dtype=np.int32)
     for r, p in enumerate(prompts):
         ids[r, lmax - len(p):] = np.asarray(p, dtype=np.int32)
@@ -404,20 +442,23 @@ _warned_attn_fn_ignored = False
 def generate(model: LlamaModel, variables, prompt_ids, max_new_tokens: int,
              temperature: float = 0.0, rng=None, pad_to: int | None = None,
              pad_lens=None, top_k: int = 0, top_p: float = 1.0,
-             eos_id: int | None = None):
+             eos_id: int | None = None, return_steps: bool = False):
     """Greedy / temperature sampling with a KV cache.
 
     Two jitted programs: a prefill pass writes the prompt's cache in a
-    single chunked update, then a ``lax.scan`` decode emits one token per
-    step (compiled per (batch, cache-size) only). For mixed-length columns,
+    single chunked update, then a decode loop emits one token per step
+    (compiled per (batch, cache-size) only). For mixed-length columns,
     left-pad with :func:`left_pad_prompts` and pass ``pad_lens`` — the
     prefill then also compiles ONCE for the whole column (positions count
     from each row's first real token; pad slots are masked out of
-    attention).
+    attention). With ``eos_id`` the decode is a ``lax.while_loop`` that
+    exits as soon as every row has finished — the compute-side early stop.
 
     ``prompt_ids``: [B, Lp] int32, Lp >= 1. Returns [B, Lp+max_new_tokens]
     (left-pad slots included when ``pad_lens`` is used — strip
-    ``pad_lens[r]`` leading ids per row).
+    ``pad_lens[r]`` leading ids per row). With ``return_steps=True``
+    returns ``(ids, n_decode_steps)`` — the observable for early-exit
+    tests and serving telemetry.
     """
     global _warned_attn_fn_ignored
     # Warn only for an EXPLICITLY configured attn_fn — the "auto" default
@@ -454,12 +495,13 @@ def generate(model: LlamaModel, variables, prompt_ids, max_new_tokens: int,
         pad_lens = jnp.asarray(pad_lens, jnp.int32)
     cache = init_cache(model, b, int(max_len))
     last_logits, cache = _prefill(model, params, prompt_ids, cache, pad_lens)
-    toks = _decode(model, params, cache, last_logits, rng, pad_lens,
-                   max_new_tokens=int(max_new_tokens),
-                   temperature=float(temperature), top_k=int(top_k),
-                   top_p=float(top_p),
-                   eos_id=None if eos_id is None else int(eos_id))
-    return jnp.concatenate([prompt_ids, toks], axis=1)
+    toks, n_steps = _decode(model, params, cache, last_logits, rng, pad_lens,
+                            max_new_tokens=int(max_new_tokens),
+                            temperature=float(temperature), top_k=int(top_k),
+                            top_p=float(top_p),
+                            eos_id=None if eos_id is None else int(eos_id))
+    ids = jnp.concatenate([prompt_ids, toks], axis=1)
+    return (ids, int(n_steps)) if return_steps else ids
 
 
 # ---------------------------------------------------------------------------

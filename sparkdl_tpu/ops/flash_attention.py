@@ -257,7 +257,8 @@ def flash_attention(q, k, v, causal: bool = False, *, kv_mask=None,
 
 def _resolve(interpret: bool | None) -> bool:
     if interpret is None:
-        return jax.default_backend() not in ("tpu",)
+        from sparkdl_tpu.utils.platform import is_tpu_backend
+        return not is_tpu_backend()
     return interpret
 
 
@@ -266,8 +267,13 @@ def auto_attn_fn():
     ``None`` (dense attention in-model) elsewhere. Models accept the
     returned value as their ``attn_fn``; pass through to
     ``LlamaModel(attn_fn=auto_attn_fn())`` / ``BertEncoder(attn_fn=…)``.
-    """
-    if jax.default_backend() == "tpu":
+
+    "On TPU" is decided by :func:`utils.platform.is_tpu_backend`, which
+    also recognizes the axon PJRT plugin (platform string "axon",
+    device_kind "TPU v5 …") — gating on the literal backend name alone
+    would silently keep dense attention on the real chip."""
+    from sparkdl_tpu.utils.platform import is_tpu_backend
+    if is_tpu_backend():
         return flash_attention
     return None
 

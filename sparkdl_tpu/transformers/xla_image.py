@@ -177,15 +177,38 @@ class XlaImageTransformer(PicklesCallableParams, Transformer, HasInputCol,
                     dtype=feed_dtype)
                  for i in range(0, batch.num_rows, batch_size)),
                 maxsize=runner.prefetch)
-            outs = list(runner.run(chunks))
-            result = np.concatenate([np.asarray(o) for o in outs], axis=0)
-            if out_mode == "image":
-                structs = imageIO.nhwcToStructs(
-                    np.clip(result, 0, 255).astype(np.uint8),
-                    channelOrder=order)
-                return _set_column(batch, out_col,
-                                   pa.array(structs, type=imageIO.imageSchema))
-            return _set_column(batch, out_col, arrayColumnToArrow(result))
+            # Convert each device chunk to its FINAL Arrow representation
+            # as it lands — the float32 model output for the whole
+            # partition never materializes on the host (round-3 verdict
+            # Next #8: output-side host memory). Peak output-side memory =
+            # one float32 chunk + the (uint8-struct / packed-list) column
+            # itself, instead of 2x the partition in float32.
+            pieces = []
+            for o in runner.run(chunks):
+                result = np.asarray(o)
+                if out_mode == "image":
+                    structs = imageIO.nhwcToStructs(
+                        np.clip(result, 0, 255).astype(np.uint8),
+                        channelOrder=order)
+                    pieces.append(pa.array(structs,
+                                           type=imageIO.imageSchema))
+                else:
+                    pieces.append(arrayColumnToArrow(result))
+            if len(pieces) == 1:
+                out_arr = pieces[0]
+            else:
+                # int32 list offsets overflow past 2**31 total values —
+                # promote every piece to large_list before concat (the
+                # single-array path got this via arrayColumnToArrow).
+                total = sum(len(p.values) if isinstance(
+                    p, (pa.ListArray, pa.LargeListArray)) else 0
+                    for p in pieces)
+                if total > np.iinfo(np.int32).max:
+                    pieces = [p.cast(pa.large_list(p.type.value_type))
+                              if isinstance(p, pa.ListArray) else p
+                              for p in pieces]
+                out_arr = pa.concat_arrays(pieces)
+            return _set_column(batch, out_col, out_arr)
 
         return dataset.mapBatches(_length_preserving(op))
 

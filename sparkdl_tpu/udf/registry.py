@@ -119,52 +119,90 @@ def registerGenerationUDF(name: str, model, variables,
                         f"got {eos_id!r}")
 
     def apply(df: DataFrame, inputCol: str, outputCol: str) -> DataFrame:
-        import pandas as pd
-        pdf = df.toPandas()
-        prompts = pdf[inputCol].to_list()
-        for i, p in enumerate(prompts):
-            if len(p) == 0:
+        import pyarrow as pa
+        import pyarrow.compute as pc
+
+        from ..core.frame import _set_column
+
+        # Streaming data plane (round-3 verdict Next #5): the prompt column
+        # never materializes whole on the host. Pass 1 walks the column in
+        # ``batchRows`` Arrow chunks reading LENGTHS only, to pin the
+        # column-wide max prompt length — the one value that must be global
+        # for every chunk to share a single compiled (rows, lmax) prefill/
+        # decode signature. Pass 2 re-streams the same chunks through
+        # generate(). Host memory is O(batchRows) input rows + the output
+        # column itself.
+        if df._ops:
+            # Two passes would execute pending upstream ops (tokenizers,
+            # mapBatches, ...) twice; materialize once instead. Token-id
+            # columns are small — the memory tradeoff only bites on frames
+            # that are already op-free (the common fromPandas/fromArrow
+            # case), which skip this.
+            df = df.cache()
+        lmax = 0
+        n_rows = 0
+        for batch in df.iterBatches(batchRows):
+            lens = pc.list_value_length(batch.column(inputCol)) \
+                .to_numpy(zero_copy_only=False)
+            if len(lens) and int(lens.min()) == 0:
+                bad = n_rows + int(np.argmin(lens))
                 raise ValueError(
-                    f"{inputCol!r} row {i} is an empty prompt; every row "
+                    f"{inputCol!r} row {bad} is an empty prompt; every row "
                     f"needs at least one token id")
-        out: list = [None] * len(prompts)
+            n_rows += len(lens)
+            if len(lens):
+                lmax = max(lmax, int(lens.max()))
+
+        if n_rows == 0:  # keep the schema contract on an empty column
+            tbl = df.toArrow()
+            empty = pa.array([], type=pa.list_(pa.int64()))
+            if outputCol in tbl.column_names:  # replace, like _set_column
+                tbl = tbl.set_column(tbl.column_names.index(outputCol),
+                                     outputCol, empty)
+            else:
+                tbl = tbl.append_column(outputCol, empty)
+            return DataFrame.fromArrow(
+                tbl, numPartitions=max(1, df.numPartitions))
+
         rng = jax.random.PRNGKey(seed)
-        if prompts:
-            ids_all, pads_all = left_pad_prompts(prompts)
-            lmax = ids_all.shape[1]
-            for start in range(0, len(prompts), batchRows):
-                ids = ids_all[start:start + batchRows]
-                pads = pads_all[start:start + batchRows]
-                # pad the trailing chunk's ROWS up to batchRows so every
-                # chunk hits the same compiled (rows, lmax) signature; fill
-                # rows are all-pad dummies sliced off below
-                n = len(ids)
-                if n < batchRows and start > 0:
-                    fill = batchRows - n
-                    ids = np.concatenate(
-                        [ids, np.repeat(ids[:1], fill, axis=0)])
-                    pads = np.concatenate(
-                        [pads, np.repeat(pads[:1], fill, axis=0)])
-                rng, key = jax.random.split(rng)
-                gen = np.asarray(generate(
-                    model, variables, ids, max_new_tokens,
-                    temperature=temperature, rng=key,
-                    pad_to=lmax + max_new_tokens, pad_lens=pads,
-                    top_k=top_k, top_p=top_p, eos_id=eos_id))
-                for row in range(n):
-                    # strip this row's left pads: real prompt + new tokens
-                    toks = gen[row, pads[row]:].tolist()
-                    if eos_id is not None:
-                        # trim the repeated-eos tail, keep one eos
-                        plen = len(prompts[start + row])
-                        gen_part = toks[plen:]
-                        if eos_id in gen_part:
-                            gen_part = gen_part[:gen_part.index(eos_id) + 1]
-                        toks = toks[:plen] + gen_part
-                    out[start + row] = toks
-        pdf = pdf.copy()
-        pdf[outputCol] = pd.Series(out, index=pdf.index)
-        return DataFrame.fromPandas(pdf, numPartitions=df.numPartitions)
+        out_parts: list[pa.RecordBatch] = []
+        for chunk_idx, batch in enumerate(df.iterBatches(batchRows)):
+            prompts = batch.column(inputCol).to_pylist()
+            ids, pads = left_pad_prompts(prompts, pad_to=lmax)
+            # pad a trailing partial chunk's ROWS up to batchRows so every
+            # chunk hits the same compiled (rows, lmax) signature; fill
+            # rows are duplicates sliced off below. (A lone first chunk
+            # compiles at its own row count — no fill needed.)
+            n = len(ids)
+            if n < batchRows and chunk_idx > 0:
+                fill = batchRows - n
+                ids = np.concatenate(
+                    [ids, np.repeat(ids[:1], fill, axis=0)])
+                pads = np.concatenate(
+                    [pads, np.repeat(pads[:1], fill, axis=0)])
+            rng, key = jax.random.split(rng)
+            gen = np.asarray(generate(
+                model, variables, ids, max_new_tokens,
+                temperature=temperature, rng=key,
+                pad_to=lmax + max_new_tokens, pad_lens=pads,
+                top_k=top_k, top_p=top_p, eos_id=eos_id))
+            out: list = []
+            for row in range(n):
+                # strip this row's left pads: real prompt + new tokens
+                toks = gen[row, pads[row]:].tolist()
+                if eos_id is not None:
+                    # trim the repeated-eos tail, keep one eos
+                    plen = len(prompts[row])
+                    gen_part = toks[plen:]
+                    if eos_id in gen_part:
+                        gen_part = gen_part[:gen_part.index(eos_id) + 1]
+                    toks = toks[:plen] + gen_part
+                out.append(toks)
+            out_parts.append(_set_column(
+                batch, outputCol, pa.array(out, type=pa.list_(pa.int64()))))
+        # Restore the input's partition count (the pre-streaming contract;
+        # the chunk layout above is a generation detail, not an API).
+        return DataFrame(out_parts).repartition(df.numPartitions)
 
     _UDF_REGISTRY[name] = apply
 
