@@ -335,11 +335,15 @@ def _list_image_files(path: str, recursive: bool = True) -> list[str]:
     return files
 
 
-def readImages(path: str, numPartitions: int = 1, dropImageFailures: bool = True):
+def readImages(path: str, numPartitions: int = 1,
+               dropImageFailures: bool = True, sampleRatio: float = 1.0,
+               seed: int = 42):
     """Directory/file of images → DataFrame[image: imageSchema].
 
     Reference behavior: ``readImages`` returns a DataFrame with an ``image``
-    struct column, silently dropping undecodable files when asked.
+    struct column, silently dropping undecodable files when asked;
+    ``sampleRatio`` takes a seeded random fraction of the file listing
+    (the reference's large-directory sampling knob).
 
     LAZY: only file *URIs* are enumerated here; decode runs inside a
     row-wise DataFrame op at materialization time, so scoring N images
@@ -351,22 +355,37 @@ def readImages(path: str, numPartitions: int = 1, dropImageFailures: bool = True
     return readImagesWithCustomFn(path, decode_fn=decodeImage,
                                   numPartitions=numPartitions,
                                   dropImageFailures=dropImageFailures,
-                                  decodeWorkers=0)
+                                  decodeWorkers=0,
+                                  sampleRatio=sampleRatio, seed=seed)
 
 
 def readImagesWithCustomFn(path: str, decode_fn: Callable[[bytes, str], dict | None],
                            numPartitions: int = 1,
                            dropImageFailures: bool = True,
-                           decodeWorkers: int = 1):
+                           decodeWorkers: int = 1,
+                           sampleRatio: float = 1.0, seed: int = 42):
     """``decodeWorkers``: 1 (default) keeps the historical SEQUENTIAL
     contract — a custom ``decode_fn`` may use shared mutable state. Pass 0
     (auto: min(cpu_count, 16)) or N>1 to fan decode over a thread pool;
     ``decode_fn`` must then be thread-safe (the built-in PIL decoder is —
     ``readImages`` uses the pooled path)."""
     from ..core.frame import DataFrame
+    if not 0.0 < sampleRatio <= 1.0:
+        raise ValueError(f"sampleRatio must be in (0, 1], got {sampleRatio}")
     files = _list_image_files(path)
     if not files:
         raise FileNotFoundError(f"No image files under {path!r}")
+    if sampleRatio < 1.0:
+        # seeded per-file Bernoulli over the sorted listing — stable for a
+        # fixed seed regardless of numPartitions
+        rng = np.random.RandomState(seed)
+        keep = rng.random_sample(len(files)) < sampleRatio
+        files = [f for f, k in zip(files, keep) if k]
+        if not files:
+            raise ValueError(
+                f"sampleRatio={sampleRatio} over {int(keep.size)} files "
+                f"sampled zero rows (seed={seed}); raise the ratio or "
+                f"change the seed")
     workers = (min(os.cpu_count() or 1, 16) if decodeWorkers == 0
                else max(1, decodeWorkers))
 

@@ -135,7 +135,8 @@ class LlamaAttention(nn.Module):
     attn_fn: Any = "auto"
 
     @nn.compact
-    def __call__(self, x, positions, decode: bool = False, pad_lens=None):
+    def __call__(self, x, positions, decode: bool = False, pad_lens=None,
+                 first_chunk: bool = True):
         c, d = self.cfg, self.dtype
         B, S, _ = x.shape
         hd = c.head_dim
@@ -152,6 +153,24 @@ class LlamaAttention(nn.Module):
 
         rep = c.num_heads // c.num_kv_heads  # GQA tiling factor (static)
 
+        def prefill_attn_fn(need_mask: bool):
+            """The attention to run at prefill: the resolved attn_fn when
+            it can express the left-pad mask contract (flash can; ring/
+            Ulysses cannot — they fall back to the dense cache path)."""
+            from ..ops.flash_attention import resolve_attn_fn
+            fn = resolve_attn_fn(self.attn_fn)
+            if fn is None or not need_mask:
+                return fn
+            import inspect
+            try:
+                params = inspect.signature(fn).parameters
+            except (TypeError, ValueError):
+                return None
+            # Only an explicit kv_mask parameter proves support — a
+            # **kwargs wrapper would swallow the mask and silently attend
+            # to pad tokens.
+            return fn if "kv_mask" in params else None
+
         if decode:
             # KV-cache serving path. The cache is sized by the *init* call's
             # sequence length (= max_len); apply() calls then write chunks —
@@ -161,10 +180,12 @@ class LlamaAttention(nn.Module):
             # cache slots are dead — masked out of attention, and rope
             # positions count from the first REAL token, so ONE compiled
             # prefill serves every prompt length (udf.registerGenerationUDF).
-            # NB: ``attn_fn`` (ring/Ulysses/flash) applies to the training
-            # path only; cache attention is computed here (generate() warns
-            # host-side once). Sequence-parallel serving is a future kernel
-            # (cache-aware flash decode).
+            # PREFILL (S > 1, cache index 0) runs through ``attn_fn`` when it
+            # supports the mask contract: causal over the square S-slice +
+            # kv_mask for pad slots — long prompts never materialize the
+            # O(S·max_len) score matrix (flash is the TPU default). Per-token
+            # DECODE steps (S == 1) always use dense cache attention; a
+            # cache-aware flash decode kernel is future work.
             k_cache = self.variable("cache", "k", jnp.zeros,
                                     (B, c.num_kv_heads, S, hd), d)
             v_cache = self.variable("cache", "v", jnp.zeros,
@@ -190,25 +211,47 @@ class LlamaAttention(nn.Module):
                     v_cache.value, v, (0, 0, cur, 0))
                 k_cache.value, v_cache.value = k_all, v_all
                 idx.value = cur + S
-                # grouped-query attention against the UNtiled cache: fold
-                # the GQA tiling into the einsum group axis instead of
-                # jnp.repeat-copying the whole cache every step
-                max_len = k_all.shape[2]
-                qg = q.reshape(B, c.num_kv_heads, rep, S, hd)
-                s = jnp.einsum("bgrqd,bgkd->bgrqk", qg,
-                               k_all) / math.sqrt(hd)
-                col = jnp.arange(max_len)[None, :]
-                row = cur + jnp.arange(S)[:, None]
-                valid = (col <= row)  # [S, max_len] causal-vs-cache
-                if valid_extra is not None:
-                    # [B, S, max_len]: also exclude each row's pad slots
-                    valid = valid[None] & (
-                        col[None] >= valid_extra[:, None, None])
-                    valid = valid[:, None, None]  # [B,1,1,S,max_len]
-                s = jnp.where(valid, s.astype(jnp.float32), -1e30)
-                p = jax.nn.softmax(s, axis=-1).astype(d)
-                o = jnp.einsum("bgrqk,bgkd->bgrqd", p, v_all).reshape(
-                    B, c.num_heads, S, hd)
+                flash = (prefill_attn_fn(valid_extra is not None)
+                         if S > 1 and first_chunk else None)
+                if flash is not None:
+                    # Prefill through the kernel over the square S-slice:
+                    # generate()'s contract writes the whole prompt at
+                    # cache index 0, where every slot past S is causally
+                    # dead — so attention over (q, k, v) with causal + a
+                    # pad-slot kv_mask equals the masked dense-vs-cache
+                    # compute, without materializing O(S·max_len) scores.
+                    # A chunked multi-call prefill must attend earlier
+                    # cache too — callers pass first_chunk=False for every
+                    # chunk after the first, which takes the dense path.
+                    kf = jnp.repeat(k, rep, axis=1) if rep != 1 else k
+                    vf = jnp.repeat(v, rep, axis=1) if rep != 1 else v
+                    if valid_extra is None:
+                        o = flash(q, kf, vf, causal=True)
+                    else:
+                        kv_mask = (jnp.arange(S)[None, :]
+                                   >= valid_extra[:, None]).astype(
+                                       jnp.float32)
+                        o = flash(q, kf, vf, causal=True, kv_mask=kv_mask)
+                else:
+                    # grouped-query attention against the UNtiled cache:
+                    # fold the GQA tiling into the einsum group axis instead
+                    # of jnp.repeat-copying the whole cache every step
+                    max_len = k_all.shape[2]
+                    qg = q.reshape(B, c.num_kv_heads, rep, S, hd)
+                    s = jnp.einsum("bgrqd,bgkd->bgrqk", qg,
+                                   k_all) / math.sqrt(hd)
+                    col = jnp.arange(max_len)[None, :]
+                    row = cur + jnp.arange(S)[:, None]
+                    valid = (col <= row)  # [S, max_len] causal-vs-cache
+                    if valid_extra is not None:
+                        # [B, S, max_len]: also exclude each row's pad slots
+                        valid = valid[None] & (
+                            col[None] >= valid_extra[:, None, None])
+                        valid = valid[:, None, None]  # [B,1,1,S,max_len]
+                    s = jnp.where(valid, s.astype(jnp.float32), -1e30)
+                    p = jax.nn.softmax(s, axis=-1).astype(d)
+                    o = jnp.einsum("bgrqk,bgkd->bgrqd", p, v_all).reshape(
+                        B, c.num_heads, S, hd)
             else:
                 o = jnp.zeros((B, c.num_heads, S, hd), d)
         else:
@@ -257,11 +300,12 @@ class LlamaLayer(nn.Module):
     attn_fn: Any = "auto"
 
     @nn.compact
-    def __call__(self, x, positions, decode: bool = False, pad_lens=None):
+    def __call__(self, x, positions, decode: bool = False, pad_lens=None,
+                 first_chunk: bool = True):
         c = self.cfg
         x = x + LlamaAttention(c, self.dtype, self.attn_fn, name="attn")(
             RMSNorm(c.rms_norm_eps, name="attn_norm")(x), positions, decode,
-            pad_lens)
+            pad_lens, first_chunk)
         x = x + LlamaMLP(c, self.dtype, name="mlp")(
             RMSNorm(c.rms_norm_eps, name="mlp_norm")(x))
         return x
@@ -274,7 +318,14 @@ class LlamaModel(nn.Module):
     attn_fn: Any = "auto"  # flash on TPU, dense elsewhere; or a callable
 
     @nn.compact
-    def __call__(self, input_ids, decode: bool = False, pad_lens=None):
+    def __call__(self, input_ids, decode: bool = False, pad_lens=None,
+                 first_chunk: bool = True):
+        """``first_chunk`` (decode mode, static): True when this apply()
+        writes at cache index 0 — generate()'s single-call prefill, the
+        only prefill shape this framework issues. Callers implementing a
+        chunked multi-call prefill MUST pass False for every chunk after
+        the first so attention sees earlier cache (the flash fast path is
+        square over the current chunk only)."""
         c = self.cfg
         if pad_lens is not None and not decode:
             raise ValueError(
@@ -287,7 +338,8 @@ class LlamaModel(nn.Module):
                      name="embed_tokens")(input_ids)
         for i in range(c.num_layers):
             x = LlamaLayer(c, self.dtype, self.attn_fn,
-                           name=f"layer_{i}")(x, positions, decode, pad_lens)
+                           name=f"layer_{i}")(x, positions, decode,
+                                              pad_lens, first_chunk)
         x = RMSNorm(c.rms_norm_eps, name="final_norm")(x)
         return nn.Dense(c.vocab_size, use_bias=False, dtype=jnp.float32,
                         name="lm_head")(x)
@@ -462,14 +514,15 @@ def generate(model: LlamaModel, variables, prompt_ids, max_new_tokens: int,
     """
     global _warned_attn_fn_ignored
     # Warn only for an EXPLICITLY configured attn_fn — the "auto" default
-    # resolving to flash for training is not a user setting being ignored.
+    # resolving to flash for prefill is not a user setting being ignored.
     if callable(model.attn_fn) and not _warned_attn_fn_ignored:
         # Host-side, once — not inside the traced apply (fires per trace).
         import logging
         logging.getLogger(__name__).warning(
-            "LlamaModel.attn_fn is ignored during generation; decode uses "
-            "dense cache attention (sequence-parallel serving is a future "
-            "cache-aware kernel)")
+            "LlamaModel.attn_fn applies to the PREFILL pass only during "
+            "generation (when it supports the kv_mask contract); per-token "
+            "decode uses dense cache attention (sequence-parallel serving "
+            "is a future cache-aware kernel)")
         _warned_attn_fn_ignored = True
     if not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p} — 0 would "

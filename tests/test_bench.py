@@ -1,0 +1,74 @@
+"""Bench orchestrator contract tests (round-3 verdict Next #1).
+
+The hard requirement: bench.py ALWAYS prints exactly one parsed JSON
+record, fast, whatever the backend does — a hung backend (the r01/r03
+outage) must produce a machine-readable error within the probe timeout,
+and an exhausted wall budget must surface as budget_exhausted, never as
+silence or a SIGKILL with no record.
+
+These run bench.py as a real subprocess with the test env inherited
+(conftest pins JAX_PLATFORMS=cpu), so the probe worker exercises the same
+code path the driver does.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+_BENCH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "bench.py")
+
+
+def _run(env_extra: dict, timeout: float = 120) -> dict:
+    env = dict(os.environ)
+    env.update(env_extra)
+    proc = subprocess.run([sys.executable, _BENCH], capture_output=True,
+                          text=True, timeout=timeout, env=env)
+    lines = [ln for ln in proc.stdout.strip().splitlines()
+             if ln.strip().startswith("{")]
+    assert lines, f"no JSON record printed; stdout={proc.stdout[-400:]!r} " \
+                  f"stderr={proc.stderr[-400:]!r}"
+    return json.loads(lines[-1])
+
+
+def test_hung_backend_yields_error_record_fast():
+    """Simulated hang (every worker sleeps): the record must print within
+    roughly the probe timeout, with the outage machine-readable."""
+    t0 = time.monotonic()
+    rec = _run({"BENCH_FAKE_HANG_S": "300", "BENCH_PROBE_TIMEOUT_S": "5",
+                "BENCH_WALL_S": "60"})
+    wall = time.monotonic() - t0
+    assert rec["value"] == 0.0
+    assert rec["vs_baseline"] == 0.0
+    assert rec["error"]["kind"] == "backend_unavailable"
+    assert rec["extra"]["probe_error"]["kind"] == "timeout"
+    assert wall < 30, f"error record took {wall:.0f}s"
+
+
+def test_exhausted_budget_yields_error_record():
+    """A wall budget too small for even the probe must still produce the
+    record, flagged budget_exhausted."""
+    rec = _run({"BENCH_WALL_S": "1"})
+    assert rec["value"] == 0.0
+    assert rec["error"]["kind"] == "backend_unavailable"
+    assert rec["extra"]["probe_error"]["kind"] == "budget_exhausted"
+
+
+@pytest.mark.slow
+def test_probe_worker_records_backend_identity():
+    """The probe leg must report what the backend registers as — the
+    artifact that settles the axon-vs-tpu platform-gate question each
+    round (round-3 verdict Missing #2)."""
+    proc = subprocess.run(
+        [sys.executable, _BENCH, "--worker", "probe"],
+        capture_output=True, text=True, timeout=180, env=dict(os.environ))
+    assert proc.returncode == 0, proc.stderr[-400:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    for key in ("default_backend", "device_kind", "is_tpu", "compiled_ok",
+                "flash_attention_default"):
+        assert key in rec, f"probe record missing {key}: {rec}"
+    assert rec["compiled_ok"] is True

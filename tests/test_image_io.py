@@ -107,6 +107,20 @@ def test_read_images_dir(tmp_path):
         imageIO.readImages(str(tmp_path / "empty-dir"))
 
 
+def test_read_images_sample_ratio(tmp_path):
+    from PIL import Image
+    for i in range(40):
+        Image.fromarray(rand_img(seed=i)).save(tmp_path / f"img_{i:02d}.png")
+    full = imageIO.readImages(str(tmp_path)).count()
+    assert full == 40
+    n1 = imageIO.readImages(str(tmp_path), sampleRatio=0.5, seed=7).count()
+    n2 = imageIO.readImages(str(tmp_path), sampleRatio=0.5, seed=7).count()
+    assert n1 == n2  # seeded → reproducible
+    assert 0 < n1 < 40
+    with pytest.raises(ValueError, match="sampleRatio"):
+        imageIO.readImages(str(tmp_path), sampleRatio=0.0)
+
+
 def test_read_images_keep_failures(tmp_path):
     from PIL import Image
     Image.fromarray(rand_img()).save(tmp_path / "ok.png")
