@@ -326,7 +326,9 @@ def _list_image_files(path: str, recursive: bool = True) -> list[str]:
     if os.path.isfile(path):
         return [path]
     files = []
-    for root, _dirs, names in os.walk(path):
+    for root, dirs, names in os.walk(path):
+        dirs.sort()  # deterministic walk order — seeded sampleRatio
+        # draws the same files on every filesystem
         for n in sorted(names):
             if os.path.splitext(n)[1].lower() in _IMAGE_EXTENSIONS:
                 files.append(os.path.join(root, n))

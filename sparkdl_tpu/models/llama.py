@@ -183,9 +183,11 @@ class LlamaAttention(nn.Module):
             # PREFILL (S > 1, cache index 0) runs through ``attn_fn`` when it
             # supports the mask contract: causal over the square S-slice +
             # kv_mask for pad slots — long prompts never materialize the
-            # O(S·max_len) score matrix (flash is the TPU default). Per-token
-            # DECODE steps (S == 1) always use dense cache attention; a
-            # cache-aware flash decode kernel is future work.
+            # O(S·max_len) score matrix (flash is the TPU default), and a
+            # ring/Ulysses attn_fn shards the prefill's S^2 compute over the
+            # sp mesh axis (sequence-parallel serving; unpadded prompts).
+            # Per-token DECODE steps (S == 1) always use dense cache
+            # attention; a cache-aware flash decode kernel is future work.
             k_cache = self.variable("cache", "k", jnp.zeros,
                                     (B, c.num_kv_heads, S, hd), d)
             v_cache = self.variable("cache", "v", jnp.zeros,
@@ -211,28 +213,42 @@ class LlamaAttention(nn.Module):
                     v_cache.value, v, (0, 0, cur, 0))
                 k_cache.value, v_cache.value = k_all, v_all
                 idx.value = cur + S
+                # Prefill through attn_fn over the square S-slice:
+                # generate()'s contract writes the whole prompt at cache
+                # index 0, where every slot past S is causally dead — so
+                # attention over (q, k, v) with causal + a pad-slot
+                # kv_mask equals the masked dense-vs-cache compute,
+                # without materializing O(S·max_len) scores (flash), or
+                # sharding the S^2 compute over the sp axis (ring).
+                # A chunked multi-call prefill must attend earlier cache
+                # too — callers pass first_chunk=False for chunks after
+                # the first, which takes the dense path.
                 flash = (prefill_attn_fn(valid_extra is not None)
                          if S > 1 and first_chunk else None)
+                o = None
                 if flash is not None:
-                    # Prefill through the kernel over the square S-slice:
-                    # generate()'s contract writes the whole prompt at
-                    # cache index 0, where every slot past S is causally
-                    # dead — so attention over (q, k, v) with causal + a
-                    # pad-slot kv_mask equals the masked dense-vs-cache
-                    # compute, without materializing O(S·max_len) scores.
-                    # A chunked multi-call prefill must attend earlier
-                    # cache too — callers pass first_chunk=False for every
-                    # chunk after the first, which takes the dense path.
                     kf = jnp.repeat(k, rep, axis=1) if rep != 1 else k
                     vf = jnp.repeat(v, rep, axis=1) if rep != 1 else v
-                    if valid_extra is None:
-                        o = flash(q, kf, vf, causal=True)
-                    else:
-                        kv_mask = (jnp.arange(S)[None, :]
-                                   >= valid_extra[:, None]).astype(
-                                       jnp.float32)
-                        o = flash(q, kf, vf, causal=True, kv_mask=kv_mask)
-                else:
+                    # Shape constraints (e.g. a ring attn_fn whose sp
+                    # axis doesn't divide S) surface at TRACE time — fall
+                    # back to the dense path instead of turning a
+                    # previously working generate() into a crash.
+                    try:
+                        if valid_extra is None:
+                            o = flash(q, kf, vf, causal=True)
+                        else:
+                            kv_mask = (jnp.arange(S)[None, :]
+                                       >= valid_extra[:, None]).astype(
+                                           jnp.float32)
+                            o = flash(q, kf, vf, causal=True,
+                                      kv_mask=kv_mask)
+                    except Exception as e:
+                        import logging
+                        logging.getLogger(__name__).warning(
+                            "prefill attn_fn %r failed at trace time "
+                            "(%s); using dense cache attention", flash, e)
+                        o = None
+                if o is None:
                     # grouped-query attention against the UNtiled cache:
                     # fold the GQA tiling into the einsum group axis instead
                     # of jnp.repeat-copying the whole cache every step
@@ -520,9 +536,10 @@ def generate(model: LlamaModel, variables, prompt_ids, max_new_tokens: int,
         import logging
         logging.getLogger(__name__).warning(
             "LlamaModel.attn_fn applies to the PREFILL pass only during "
-            "generation (when it supports the kv_mask contract); per-token "
-            "decode uses dense cache attention (sequence-parallel serving "
-            "is a future cache-aware kernel)")
+            "generation (flash/ring/Ulysses; left-padded prefill "
+            "additionally needs kv_mask support, which only flash has); "
+            "per-token decode uses dense cache attention (a cache-aware "
+            "flash decode kernel is future work)")
         _warned_attn_fn_ignored = True
     if not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p} — 0 would "

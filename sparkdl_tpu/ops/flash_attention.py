@@ -111,8 +111,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, acc_ref,
 def _fwd(q, k, v, kv_mask, causal: bool, block_q: int, block_k: int,
          interpret: bool):
     b, h, s, d = q.shape
-    bq = min(block_q, s)
-    bk = min(block_k, s)
+    # Blocks never shrink below the 128-lane alignment: a sequence shorter
+    # than the block is PADDED up to it instead (the seq_len mask keeps the
+    # math exact). Shrinking to odd sizes (min(block, s) with s=37) would
+    # hand Mosaic 37-wide score tiles — an alignment hazard the interpret-
+    # mode tests cannot catch. Callers may still pass smaller explicit
+    # blocks for interpret-mode tests.
+    bq = min(block_q, pl.cdiv(s, _LANES) * _LANES)
+    bk = min(block_k, pl.cdiv(s, _LANES) * _LANES)
     unit = math.lcm(bq, bk)
     s_pad = pl.cdiv(s, unit) * unit
     sm_scale = 1.0 / math.sqrt(d)

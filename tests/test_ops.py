@@ -37,6 +37,25 @@ def test_ragged_sequence_lengths(s):
     np.testing.assert_allclose(np.asarray(o), np.asarray(ref), atol=2e-5)
 
 
+@pytest.mark.parametrize("s", [4, 37, 100, 130])
+def test_ragged_with_default_blocks(s):
+    """Arbitrary sequence lengths through the DEFAULT (128) blocks — the
+    shapes the generation-UDF prefill hands the kernel on TPU. Blocks stay
+    lane-aligned; S pads up inside _fwd."""
+    q, k, v = _rand_qkv(s=s, seed=s)
+    o = flash_attention(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(o),
+                               np.asarray(dense_attention(q, k, v, True)),
+                               atol=2e-5)
+    lens = np.minimum([s, max(1, s // 2)], s)
+    kv_mask = jnp.asarray((np.arange(s)[None, :]
+                           < np.asarray(lens)[:, None]).astype(np.float32))
+    o2 = flash_attention(q, k, v, False, kv_mask=kv_mask)
+    np.testing.assert_allclose(
+        np.asarray(o2), np.asarray(_masked_dense(q, k, v, kv_mask, False)),
+        atol=2e-5)
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_gradients_match_dense(causal):
     q, k, v = _rand_qkv(s=96, d=16)
