@@ -753,6 +753,12 @@ def main():
 
     extra["budget"] = {"wall_s": budget.wall_s,
                        "spent_s": round(budget.spent(), 1)}
+    try:  # map the numbers to the code that produced them
+        extra["git_rev"] = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+            text=True, cwd=_HERE, timeout=10).stdout.strip() or None
+    except Exception:
+        pass
 
     record = {
         "metric": "resnet50_dp_train_throughput",

@@ -29,7 +29,8 @@ from .graph import (GraphFunction, IsolatedSession, TFInputGraph,
                     XlaInputGraph, buildFlattener, buildSpImageConverter,
                     makeGraphUDF)
 from .ops import flash_attention
-from .image.imageIO import imageSchema, readImages, readImagesWithCustomFn
+from .image.imageIO import (createResizeImageUDF, imageSchema, readImages,
+                            readImagesWithCustomFn)
 from .models import load_pretrained
 from .transformers import (DeepImageFeaturizer, DeepImagePredictor,
                            KerasImageFileTransformer, KerasTransformer,
@@ -48,6 +49,7 @@ __all__ = [
     "Transformer", "Estimator", "Model", "Evaluator",
     "Pipeline", "PipelineModel", "MLWritable", "load",
     "imageSchema", "readImages", "readImagesWithCustomFn",
+    "createResizeImageUDF",
     "load_pretrained",
     "XlaImageTransformer", "TFImageTransformer",
     "DeepImageFeaturizer", "DeepImagePredictor",

@@ -18,6 +18,7 @@ without intermediate materialization), which is what feeds the HBM pipeline in
 
 from __future__ import annotations
 
+import os
 from typing import Any, Callable, Iterator, Sequence
 
 import numpy as np
@@ -260,6 +261,43 @@ class DataFrame:
             out.append(DataFrame.fromArrow(
                 table.take(pa.array(np.sort(idxs)))))
         return out
+
+    @classmethod
+    def fromParquet(cls, path: str, numPartitions: int | None = None
+                    ) -> "DataFrame":
+        """Read a parquet file/dataset directory. Row groups become
+        partitions unless ``numPartitions`` forces a re-split — the
+        durable interchange format for feature columns (the Spark
+        reference read/wrote DataFrames via parquet natively)."""
+        import pyarrow.parquet as pq
+        f = pq.ParquetFile(path) if os.path.isfile(path) else None
+        if f is not None and numPartitions is None:
+            parts = []
+            for i in range(f.num_row_groups):
+                t = f.read_row_group(i).combine_chunks()
+                parts.extend(t.to_batches(max_chunksize=max(1, len(t))))
+            if parts:
+                return cls(parts)
+        table = pq.read_table(path)
+        return cls.fromArrow(table, numPartitions or 1)
+
+    def toParquet(self, path: str) -> None:
+        """Write all partitions as one parquet file, one row group per
+        non-empty partition (fromParquet then round-trips that
+        partitioning; zero-row partitions are dropped — their degenerate
+        column types cannot be written). One streaming pass: the op chain
+        runs once, one partition resident at a time."""
+        import pyarrow.parquet as pq
+        writer = None
+        try:
+            for b in self.iterPartitions():
+                if writer is None:
+                    writer = pq.ParquetWriter(path, b.schema)
+                if b.num_rows:
+                    writer.write_table(pa.Table.from_batches([b]))
+        finally:
+            if writer is not None:
+                writer.close()
 
     def toArrow(self) -> pa.Table:
         batches = [b for b in self.iterPartitions()]

@@ -158,6 +158,18 @@ def resizeImage(struct: dict, height: int, width: int) -> dict:
     return imageArrayToStruct(resized, origin=struct.get("origin", ""))
 
 
+def createResizeImageUDF(height: int, width: int):
+    """Row-wise resize fn for ``DataFrame.withColumn`` — the reference's
+    ``createResizeImageUDF(size)`` surface: register once, apply to any
+    image-struct column. (Batch hot paths resize inside the packer /
+    ``imageColumnToNHWC`` instead.)"""
+
+    def resize(struct: dict) -> dict:
+        return resizeImage(struct, height, width)
+
+    return resize
+
+
 def resizeImageBatchNHWC(batch: np.ndarray, height: int, width: int) -> np.ndarray:
     """Vectorized NHWC resize on device-bound data.
 

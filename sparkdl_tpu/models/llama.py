@@ -230,9 +230,13 @@ class LlamaAttention(nn.Module):
                     kf = jnp.repeat(k, rep, axis=1) if rep != 1 else k
                     vf = jnp.repeat(v, rep, axis=1) if rep != 1 else v
                     # Shape constraints (e.g. a ring attn_fn whose sp
-                    # axis doesn't divide S) surface at TRACE time — fall
-                    # back to the dense path instead of turning a
-                    # previously working generate() into a crash.
+                    # axis doesn't divide S) surface at TRACE time as
+                    # ValueError/TypeError — fall back to the dense path
+                    # instead of turning a previously working generate()
+                    # into a crash. Other exception types (a genuinely
+                    # broken attn_fn) propagate: silently densifying
+                    # those would OOM the long-prompt case the fn was
+                    # configured to avoid.
                     try:
                         if valid_extra is None:
                             o = flash(q, kf, vf, causal=True)
@@ -242,11 +246,8 @@ class LlamaAttention(nn.Module):
                                            jnp.float32)
                             o = flash(q, kf, vf, causal=True,
                                       kv_mask=kv_mask)
-                    except Exception as e:
-                        import logging
-                        logging.getLogger(__name__).warning(
-                            "prefill attn_fn %r failed at trace time "
-                            "(%s); using dense cache attention", flash, e)
+                    except (TypeError, ValueError) as e:
+                        _warn_prefill_fallback(flash, e)
                         o = None
                 if o is None:
                     # grouped-query attention against the UNtiled cache:
@@ -505,6 +506,19 @@ def left_pad_prompts(prompts, pad_id: int = 0, pad_to: int | None = None):
 
 
 _warned_attn_fn_ignored = False
+_warned_prefill_fallback: set = set()
+
+
+def _warn_prefill_fallback(fn, err) -> None:
+    """Once per (fn, error) pair host-side — not once per layer per trace
+    (a 32-layer model would otherwise emit 32 identical warnings)."""
+    key = (repr(fn), f"{type(err).__name__}: {err}")
+    if key not in _warned_prefill_fallback:
+        import logging
+        logging.getLogger(__name__).warning(
+            "prefill attn_fn %s failed at trace time (%s); using dense "
+            "cache attention", key[0], key[1])
+        _warned_prefill_fallback.add(key)
 
 
 def generate(model: LlamaModel, variables, prompt_ids, max_new_tokens: int,

@@ -107,6 +107,19 @@ def test_read_images_dir(tmp_path):
         imageIO.readImages(str(tmp_path / "empty-dir"))
 
 
+def test_create_resize_image_udf():
+    import sparkdl_tpu as sdl
+
+    structs = [imageIO.imageArrayToStruct(rand_img(seed=i, h=12, w=10))
+               for i in range(4)]
+    df = sdl.DataFrame.fromPydict({"image": structs})
+    out = df.withColumn("small", sdl.createResizeImageUDF(6, 5), ["image"])
+    rows = out.collect()
+    assert rows[0]["small"]["height"] == 6
+    assert rows[0]["small"]["width"] == 5
+    assert rows[0]["image"]["height"] == 12  # source untouched
+
+
 def test_read_images_sample_ratio(tmp_path):
     from PIL import Image
     for i in range(40):
