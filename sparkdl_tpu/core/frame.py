@@ -18,7 +18,6 @@ without intermediate materialization), which is what feeds the HBM pipeline in
 
 from __future__ import annotations
 
-import os
 from typing import Any, Callable, Iterator, Sequence
 
 import numpy as np
@@ -265,17 +264,19 @@ class DataFrame:
     @classmethod
     def fromParquet(cls, path: str, numPartitions: int | None = None
                     ) -> "DataFrame":
-        """Read a parquet file/dataset directory. Row groups become
-        partitions unless ``numPartitions`` forces a re-split — the
-        durable interchange format for feature columns (the Spark
-        reference read/wrote DataFrames via parquet natively)."""
+        """Read a parquet file OR dataset directory. Row groups become
+        partitions (across every file of a directory) unless
+        ``numPartitions`` forces a re-split — the durable interchange
+        format for feature columns (the Spark reference read/wrote
+        DataFrames via parquet natively)."""
+        import pyarrow.dataset as ds
         import pyarrow.parquet as pq
-        f = pq.ParquetFile(path) if os.path.isfile(path) else None
-        if f is not None and numPartitions is None:
+        if numPartitions is None:
             parts = []
-            for i in range(f.num_row_groups):
-                t = f.read_row_group(i).combine_chunks()
-                parts.extend(t.to_batches(max_chunksize=max(1, len(t))))
+            for frag in ds.dataset(path, format="parquet").get_fragments():
+                for rg in frag.split_by_row_group():
+                    t = rg.to_table().combine_chunks()
+                    parts.extend(t.to_batches(max_chunksize=max(1, len(t))))
             if parts:
                 return cls(parts)
         table = pq.read_table(path)
@@ -285,16 +286,26 @@ class DataFrame:
         """Write all partitions as one parquet file, one row group per
         non-empty partition (fromParquet then round-trips that
         partitioning; zero-row partitions are dropped — their degenerate
-        column types cannot be written). One streaming pass: the op chain
-        runs once, one partition resident at a time."""
+        column types cannot be written, exactly as toArrow drops them).
+        One streaming pass: the op chain runs once, one partition
+        resident at a time."""
         import pyarrow.parquet as pq
         writer = None
+        first = None  # schema fallback for an all-empty frame
         try:
             for b in self.iterPartitions():
+                if first is None:
+                    first = b
+                if not b.num_rows:
+                    continue
                 if writer is None:
+                    # schema from the first NON-empty batch: an empty
+                    # batch may carry degenerate null-typed op columns
+                    # that would poison the file schema
                     writer = pq.ParquetWriter(path, b.schema)
-                if b.num_rows:
-                    writer.write_table(pa.Table.from_batches([b]))
+                writer.write_table(pa.Table.from_batches([b]))
+            if writer is None and first is not None:
+                writer = pq.ParquetWriter(path, first.schema)
         finally:
             if writer is not None:
                 writer.close()

@@ -1,0 +1,42 @@
+"""The examples/ scripts must actually run — they are the user-facing
+entry documentation (the reference shipped runnable examples; stale ones
+are worse than none). Each runs in a subprocess on the CPU test platform
+with tiny sizes."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_EX = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples")
+
+
+def _run(name: str, extra_env: dict | None = None, timeout: int = 420):
+    env = dict(os.environ)
+    env.update(extra_env or {})
+    proc = subprocess.run([sys.executable, os.path.join(_EX, name)],
+                          capture_output=True, text=True, timeout=timeout,
+                          env=env)
+    assert proc.returncode == 0, \
+        f"{name} failed:\n{proc.stderr[-1500:]}\n{proc.stdout[-500:]}"
+    return proc.stdout
+
+
+@pytest.mark.slow
+def test_transfer_learning_example():
+    out = _run("transfer_learning.py", {"N_IMAGES": "8"})
+    assert "train accuracy" in out
+
+
+@pytest.mark.slow
+def test_distributed_training_example():
+    out = _run("distributed_training.py",
+               {"STEPS": "3", "BATCH_PER_CHIP": "2"})
+    assert "-device DP: loss" in out
+
+
+def test_generation_serving_example():
+    out = _run("generation_serving.py")
+    assert "ONE prefill + ONE decode program" in out

@@ -189,3 +189,35 @@ def test_parquet_round_trip(tmp_path):
     df2.toParquet(p2)
     assert [r["y"] for r in sdl.DataFrame.fromParquet(p2).collect()] == \
         [2 * i for i in range(10)]
+
+
+def test_parquet_empty_partitions_and_directories(tmp_path):
+    import pyarrow.parquet as pq
+
+    import sparkdl_tpu as sdl
+
+    # a filter emptying partition 0 leaves a degenerate null-typed op
+    # column there — the writer schema must come from a NON-empty batch
+    df = sdl.DataFrame.fromPydict({"x": [1, 2, 3, 4]}, numPartitions=2) \
+        .filter(lambda r: r["x"] > 2) \
+        .withColumn("y", lambda x: x * 2, ["x"])
+    p = str(tmp_path / "filtered.parquet")
+    df.toParquet(p)
+    back = sdl.DataFrame.fromParquet(p)
+    assert [(r["x"], r["y"]) for r in back.collect()] == [(3, 6), (4, 8)]
+
+    # dataset DIRECTORY: row groups across all member files = partitions
+    d = tmp_path / "dataset"
+    d.mkdir()
+    sdl.DataFrame.fromPydict({"x": [0, 1]}).toParquet(str(d / "a.parquet"))
+    sdl.DataFrame.fromPydict({"x": [2, 3]}, numPartitions=2) \
+        .toParquet(str(d / "b.parquet"))
+    dd = sdl.DataFrame.fromParquet(str(d))
+    assert dd.numPartitions == 3  # 1 row group + 2 row groups
+    assert sorted(r["x"] for r in dd.collect()) == [0, 1, 2, 3]
+
+    # an all-empty frame still writes a valid (0-row) file
+    empty = sdl.DataFrame.fromPydict({"x": [1]}).filter(lambda r: False)
+    pe = str(tmp_path / "empty.parquet")
+    empty.toParquet(pe)
+    assert pq.read_table(pe).num_rows == 0
