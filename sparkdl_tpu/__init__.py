@@ -38,8 +38,9 @@ from .transformers import (DeepImageFeaturizer, DeepImagePredictor,
                            XlaImageTransformer, XlaTransformer)
 from .runner import (CheckpointManager, RunnerContext, TrainState, XlaRunner,
                      make_shard_map_step, make_train_step)
-from .udf import (applyUDF, listUDFs, registerImageUDF, registerKerasImageUDF,
-                  registerUDF)
+from .udf import (applyUDF, listUDFs, registerGenerationUDF,
+                  registerImageUDF, registerKerasImageUDF,
+                  registerTextGenerationUDF, registerUDF)
 
 __all__ = [
     "DataFrame", "Row",
@@ -61,7 +62,8 @@ __all__ = [
     "MulticlassClassificationEvaluator", "RegressionEvaluator",
     "BinaryClassificationEvaluator",
     "KerasImageFileEstimator",
-    "registerUDF", "registerImageUDF", "registerKerasImageUDF", "applyUDF",
+    "registerUDF", "registerImageUDF", "registerKerasImageUDF",
+    "registerGenerationUDF", "registerTextGenerationUDF", "applyUDF",
     "listUDFs",
     "GraphFunction", "IsolatedSession", "XlaInputGraph", "TFInputGraph",
     "buildSpImageConverter", "buildFlattener", "makeGraphUDF",

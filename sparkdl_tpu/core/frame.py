@@ -372,6 +372,34 @@ class DataFrame:
             return sum(p.num_rows for p in self._partitions)
         return sum(b.num_rows for b in self.iterPartitions())
 
+    def show(self, n: int = 20, truncate: int = 20) -> None:
+        """Spark-style table print of the first ``n`` rows. ``truncate``:
+        max cell width (0 disables). Materializes only ``take(n)``."""
+        rows = self.take(n)
+        cols = self.columns
+
+        def cell(v) -> str:
+            s = str(v)
+            if truncate and len(s) > truncate:
+                # Spark semantics: truncate < 4 is a plain prefix (no room
+                # for an ellipsis inside the width budget)
+                s = (s[:truncate] if truncate < 4
+                     else s[:truncate - 3] + "...")
+            return s
+
+        data = [[cell(r.get(c)) for c in cols] for r in rows]
+        widths = [max(len(c), *(len(d[i]) for d in data)) if data
+                  else len(c) for i, c in enumerate(cols)]
+        sep = "+" + "+".join("-" * (w + 2) for w in widths) + "+"
+        print(sep)
+        print("|" + "|".join(f" {c:<{w}} "
+                             for c, w in zip(cols, widths)) + "|")
+        print(sep)
+        for d in data:
+            print("|" + "|".join(f" {v:<{w}} "
+                                 for v, w in zip(d, widths)) + "|")
+        print(sep)
+
     def __repr__(self) -> str:
         try:
             cols = ", ".join(f"{f.name}:{f.type}" for f in self.schema)

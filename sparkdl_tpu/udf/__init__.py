@@ -1,6 +1,8 @@
 from .registry import (applyUDF, listUDFs, registerGenerationUDF,
-                       registerImageUDF, registerKerasImageUDF, registerUDF,
+                       registerImageUDF, registerKerasImageUDF,
+                       registerTextGenerationUDF, registerUDF,
                        unregisterUDF)
 
 __all__ = ["registerUDF", "registerImageUDF", "registerKerasImageUDF",
-           "registerGenerationUDF", "applyUDF", "listUDFs", "unregisterUDF"]
+           "registerGenerationUDF", "registerTextGenerationUDF",
+           "applyUDF", "listUDFs", "unregisterUDF"]

@@ -98,11 +98,26 @@ def registerGenerationUDF(name: str, model, variables,
     LEFT-padded to one length (``models.llama.left_pad_prompts``) and runs
     as exactly TWO compiled XLA programs however many distinct prompt
     lengths appear: one masked prefill (positions count from each row's
-    first real token) + one ``lax.scan`` decode. No duplicate-row fill, no
-    per-length recompiles. Rows are chunked to ``batchRows`` so a huge
-    column doesn't build one giant cache (chunks of equal row count reuse
-    the same programs).
+    first real token) + one while_loop/scan decode (EOS early exit). No
+    duplicate-row fill, no per-length recompiles. Rows are chunked to
+    ``batchRows`` so a huge column doesn't build one giant cache (chunks
+    of equal row count reuse the same programs).
     """
+    _UDF_REGISTRY[name] = _make_generation_apply(
+        model, variables, max_new_tokens=max_new_tokens,
+        temperature=temperature, seed=seed, batchRows=batchRows,
+        top_k=top_k, top_p=top_p, eos_id=eos_id)
+
+
+def _make_generation_apply(model, variables, *, max_new_tokens: int = 32,
+                           temperature: float = 0.0, seed: int = 0,
+                           batchRows: int = 64, top_k: int = 0,
+                           top_p: float = 1.0,
+                           eos_id: int | None = None) -> Callable:
+    """Build (and validate) the apply closure behind
+    :func:`registerGenerationUDF` — shared with
+    :func:`registerTextGenerationUDF` so the padding/chunking/EOS
+    semantics have one source of truth."""
     import jax
     import numpy as np
 
@@ -203,6 +218,42 @@ def registerGenerationUDF(name: str, model, variables,
         # Restore the input's partition count (the pre-streaming contract;
         # the chunk layout above is a generation detail, not an API).
         return DataFrame(out_parts).repartition(df.numPartitions)
+
+    return apply
+
+
+def registerTextGenerationUDF(name: str, model, variables,
+                              encode: Callable[[str], list],
+                              decode: Callable[[list], str],
+                              **gen_kwargs) -> None:
+    """String-column twin of :func:`registerGenerationUDF`: the column
+    holds TEXT prompts; ``encode``/``decode`` are the tokenizer halves
+    (e.g. a HF tokenizer's ``tok.encode`` / ``tok.decode``). Tokenize →
+    the streamed left-padded two-program generation → detokenize, all per
+    ``batchRows`` chunk. Accepts every registerGenerationUDF keyword.
+    """
+    if not callable(encode) or not callable(decode):
+        raise TypeError("encode and decode must be callables "
+                        f"(got {encode!r}, {decode!r})")
+    inner_apply = _make_generation_apply(model, variables, **gen_kwargs)
+
+    def apply(df: DataFrame, inputCol: str, outputCol: str) -> DataFrame:
+        ids_col = f"__{name}_ids"
+        out_ids = f"__{name}_out_ids"
+        with_ids = df.withColumn(
+            ids_col, lambda s: [int(t) for t in encode(s)], [inputCol])
+        try:
+            gen = inner_apply(with_ids, ids_col, out_ids)
+        except ValueError as e:
+            # surface the USER's column name, not the hidden ids column
+            raise ValueError(
+                str(e).replace(repr(ids_col), repr(inputCol))) from None
+        # strip the prompt ids from each completion before decoding
+        def detok(prompt_ids, completion_ids):
+            return decode([int(t) for t in
+                           completion_ids[len(prompt_ids):]])
+        return gen.withColumn(outputCol, detok, [ids_col, out_ids]) \
+                  .drop(ids_col, out_ids)
 
     _UDF_REGISTRY[name] = apply
 

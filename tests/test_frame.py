@@ -221,3 +221,21 @@ def test_parquet_empty_partitions_and_directories(tmp_path):
     pe = str(tmp_path / "empty.parquet")
     empty.toParquet(pe)
     assert pq.read_table(pe).num_rows == 0
+
+
+def test_show(capsys):
+    import sparkdl_tpu as sdl
+
+    df = sdl.DataFrame.fromPydict(
+        {"name": ["a-very-long-string-that-overflows", "b"],
+         "x": [1, 22]})
+    df.show(truncate=10)
+    out = capsys.readouterr().out
+    lines = out.strip().splitlines()
+    assert lines[1].count("|") == 3  # header row: | name | x |
+    assert "a-very-..." in out  # truncated to 10 chars
+    assert "22" in out
+    # n limits the rows shown
+    df.show(n=1)
+    out2 = capsys.readouterr().out
+    assert "22" not in out2

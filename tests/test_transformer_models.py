@@ -541,6 +541,51 @@ def test_generation_udf_streams_without_full_materialization(monkeypatch):
         assert list(r["c"]) == solo[0].tolist()
 
 
+def test_text_generation_udf_string_columns():
+    """registerTextGenerationUDF: string prompts → encode → the streamed
+    token UDF → decode, with the prompt stripped from the completion and
+    helper columns dropped."""
+    import sparkdl_tpu as sdl
+    from sparkdl_tpu.models.llama import LlamaConfig, LlamaModel, generate
+    from sparkdl_tpu.udf import registerTextGenerationUDF, unregisterUDF
+
+    cfg = LlamaConfig.tiny()
+    model = LlamaModel(cfg)
+    v = model.init(jax.random.PRNGKey(0), np.zeros((1, 4), np.int32))
+
+    # toy char-level codec over a..z (ids 1..26, vocab 512 >> 27)
+    encode = lambda s: [ord(c) - ord("a") + 1 for c in s]
+    decode = lambda ids: "".join(chr(i - 1 + ord("a")) for i in ids)
+
+    texts = ["hello", "ab", "generate"]
+    df = sdl.DataFrame.fromPydict({"text": texts}, numPartitions=2)
+    registerTextGenerationUDF("complete", model, v, encode, decode,
+                              max_new_tokens=4, batchRows=2)
+    try:
+        out = sdl.applyUDF(df, "complete", "text", "rest").toPandas()
+    finally:
+        unregisterUDF("complete")
+    assert list(out.columns) == ["text", "rest"]
+    for t, rest in zip(texts, out["rest"]):
+        solo = np.asarray(generate(
+            model, v, np.asarray([encode(t)], np.int32), 4))[0]
+        assert rest == decode([int(x) for x in solo[len(encode(t)):]])
+
+    with pytest.raises(TypeError, match="encode and decode"):
+        registerTextGenerationUDF("bad", model, v, "not-callable", decode)
+
+    # an empty prompt error must name the USER's column, not the hidden
+    # internal ids column
+    df_bad = sdl.DataFrame.fromPydict({"text": ["ok", ""]})
+    registerTextGenerationUDF("t2", model, v, encode, decode,
+                              max_new_tokens=2)
+    try:
+        with pytest.raises(ValueError, match="'text' row 1"):
+            sdl.applyUDF(df_bad, "t2", "text", "out")
+    finally:
+        unregisterUDF("t2")
+
+
 def test_generation_eos_stops_rows():
     """Rows that emit eos keep emitting it (static shapes); the UDF trims
     the tail to one eos."""
