@@ -38,6 +38,8 @@ from .transformers import (DeepImageFeaturizer, DeepImagePredictor,
                            XlaImageTransformer, XlaTransformer)
 from .runner import (CheckpointManager, RunnerContext, TrainState, XlaRunner,
                      make_shard_map_step, make_train_step)
+from .transformers.feature import (IndexToString, StringIndexer,
+                                   StringIndexerModel, VectorAssembler)
 from .udf import (applyUDF, listUDFs, registerGenerationUDF,
                   registerImageUDF, registerKerasImageUDF,
                   registerTextGenerationUDF, registerUDF)
@@ -57,6 +59,8 @@ __all__ = [
     "KerasImageFileTransformer", "XlaTransformer", "TFTransformer",
     "KerasTransformer",
     "LogisticRegression", "LogisticRegressionModel",
+    "VectorAssembler", "StringIndexer", "StringIndexerModel",
+    "IndexToString",
     "ParamGridBuilder", "CrossValidator", "CrossValidatorModel",
     "TrainValidationSplit", "TrainValidationSplitModel",
     "MulticlassClassificationEvaluator", "RegressionEvaluator",

@@ -1,0 +1,205 @@
+"""Feature-engineering stages: VectorAssembler, StringIndexer, IndexToString.
+
+The reference's pipelines leaned on Spark MLlib feature stages around the
+deep-learning transformers (StringIndexer for labels, VectorAssembler to
+join feature columns before a shallow learner — e.g. the upstream README's
+``Pipeline([featurizer, lr])`` flows; SURVEY.md §1-L3). There is no JVM
+MLlib here, so the framework carries the three stages those flows need,
+with the same Params surface and fit/transform semantics.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+
+from ..core.frame import DataFrame, _row_wise_op, _set_column
+from ..core.params import (HasInputCol, HasOutputCol, Param, Params,
+                           TypeConverters, keyword_only)
+from ..core.pipeline import Estimator, Model, Transformer
+
+
+def _toHandleInvalid(value):
+    """Param converter: config errors surface at set() time on the driver
+    (the core/params.py contract), not at transform time on a worker."""
+    value = TypeConverters.toString(value)
+    if value not in ("error", "keep"):
+        raise TypeError(
+            f"handleInvalid must be 'error' or 'keep', got {value!r} "
+            "('skip' is not supported: the data plane's indexing op is "
+            "length-preserving)")
+    return value
+
+
+class VectorAssembler(Transformer, HasOutputCol):
+    """Concatenate numeric / vector columns into one flat feature vector
+    (Spark MLlib surface: inputCols → outputCol)."""
+
+    inputCols = Param(Params, "inputCols", "columns to concatenate",
+                      TypeConverters.toListString)
+
+    @keyword_only
+    def __init__(self, inputCols=None, outputCol=None):
+        super().__init__()
+        self._setDefault(outputCol="features")
+        self._set(**self._input_kwargs)
+
+    @keyword_only
+    def setParams(self, inputCols=None, outputCol=None):
+        return self._set(**self._input_kwargs)
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        cols = (self.getOrDefault(self.inputCols)
+                if self.isDefined(self.inputCols) else None)
+        if not cols:
+            raise ValueError("VectorAssembler needs inputCols")
+        out_col = self.getOutputCol()
+
+        def op(batch: pa.RecordBatch) -> pa.RecordBatch:
+            from .tensor import columnToNdarray
+            pieces = []
+            for c in cols:
+                arr = batch.column(c)
+                if arr.null_count:
+                    bad = next(i for i, v in enumerate(arr.to_pylist())
+                               if v is None)
+                    # Spark's handleInvalid='error' default: a null would
+                    # otherwise silently become NaN in the feature vector
+                    raise ValueError(
+                        f"VectorAssembler: column {c!r} has a null at "
+                        f"row {bad}; clean or filter nulls first")
+                if (pa.types.is_list(arr.type)
+                        or pa.types.is_large_list(arr.type)
+                        or pa.types.is_fixed_size_list(arr.type)):
+                    # zero-copy Arrow→ndarray (shared with the tensor
+                    # transformers; handles fixed_size_list too)
+                    a = columnToNdarray(arr, None)
+                    pieces.append(a.reshape(len(a), -1)
+                                  .astype(np.float64))
+                else:
+                    pieces.append(np.asarray(
+                        arr.to_pylist(), dtype=np.float64)[:, None])
+            flat = np.concatenate(pieces, axis=1)
+            return _set_column(batch, out_col,
+                               pa.array(list(flat), type=pa.list_(
+                                   pa.float64())))
+
+        # row-wise: each output row depends only on its own input row, so
+        # the chain stays streamable (O(batchSize) host memory upstream)
+        return dataset.mapBatches(_row_wise_op(op))
+
+
+class StringIndexer(Estimator, HasInputCol, HasOutputCol):
+    """Fit a label → index mapping over a string (or any hashable) column;
+    indices are assigned by descending frequency, ties lexicographic —
+    Spark's ``frequencyDesc`` order. Nulls are invalid values governed by
+    ``handleInvalid`` (Spark semantics), never folded into a "None"
+    label."""
+
+    handleInvalid = Param(Params, "handleInvalid",
+                          "'error' (default) or 'keep' (unseen/null → "
+                          "n_labels)", _toHandleInvalid)
+
+    @keyword_only
+    def __init__(self, inputCol=None, outputCol=None, handleInvalid=None):
+        super().__init__()
+        self._setDefault(handleInvalid="error")
+        self._set(**self._input_kwargs)
+
+    @keyword_only
+    def setParams(self, inputCol=None, outputCol=None, handleInvalid=None):
+        return self._set(**self._input_kwargs)
+
+    def _fit(self, dataset: DataFrame) -> "StringIndexerModel":
+        col = self.getInputCol()
+        keep = self.getOrDefault(self.handleInvalid) == "keep"
+        counts: dict = {}
+        # non-null values coerce through str() on both fit and transform —
+        # Spark casts the input column to string, and the labels Param
+        # stores strings
+        for batch in dataset.iterPartitions():
+            for v in batch.column(col).to_pylist():
+                if v is None:
+                    if keep:
+                        continue  # invalid value, excluded from the fit
+                    raise ValueError(
+                        f"StringIndexer: null in column {col!r} (set "
+                        f"handleInvalid='keep' to bucket nulls with "
+                        f"unseen labels)")
+                counts[str(v)] = counts.get(str(v), 0) + 1
+        labels = sorted(counts, key=lambda v: (-counts[v], v))
+        model = StringIndexerModel(labels=labels)
+        model._set(inputCol=col, outputCol=self.getOutputCol(),
+                   handleInvalid=self.getOrDefault(self.handleInvalid))
+        return model
+
+
+class StringIndexerModel(Model, HasInputCol, HasOutputCol):
+    handleInvalid = Param(Params, "handleInvalid",
+                          "'error' (default) or 'keep' (unseen/null → "
+                          "n_labels)", _toHandleInvalid)
+    labels = Param(Params, "labels", "index → label mapping",
+                   TypeConverters.toListString)
+
+    @keyword_only
+    def __init__(self, inputCol=None, outputCol=None, handleInvalid=None,
+                 labels=None):
+        super().__init__()
+        self._setDefault(handleInvalid="error")
+        self._set(**self._input_kwargs)
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        col = self.getInputCol()
+        out_col = self.getOutputCol()
+        labels = self.getOrDefault(self.labels)
+        index = {v: i for i, v in enumerate(labels)}
+        keep = self.getOrDefault(self.handleInvalid) == "keep"
+        unseen = len(labels)
+
+        def to_index(v):
+            if v is None:  # invalid value, not a "None" label
+                if keep:
+                    return unseen
+                raise ValueError(
+                    f"StringIndexerModel: null in column {col!r} (set "
+                    f"handleInvalid='keep' to map nulls to {unseen})")
+            v = str(v)
+            if v in index:
+                return index[v]
+            if keep:
+                return unseen
+            raise ValueError(
+                f"StringIndexerModel: unseen label {v!r} (set "
+                f"handleInvalid='keep' to map unseen labels to "
+                f"{unseen})")
+
+        return dataset.withColumn(out_col, to_index, [col])
+
+
+class IndexToString(Transformer, HasInputCol, HasOutputCol):
+    """Inverse of StringIndexer: index column → label strings."""
+
+    labels = Param(Params, "labels", "index → label mapping",
+                   TypeConverters.toListString)
+
+    @keyword_only
+    def __init__(self, inputCol=None, outputCol=None, labels=None):
+        super().__init__()
+        self._set(**self._input_kwargs)
+
+    @keyword_only
+    def setParams(self, inputCol=None, outputCol=None, labels=None):
+        return self._set(**self._input_kwargs)
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        labels = self.getOrDefault(self.labels)
+
+        def to_label(i):
+            i = int(i)
+            if not 0 <= i < len(labels):
+                raise ValueError(f"index {i} out of range for "
+                                 f"{len(labels)} labels")
+            return labels[i]
+
+        return dataset.withColumn(self.getOutputCol(), to_label,
+                                  [self.getInputCol()])

@@ -1,0 +1,135 @@
+"""Feature stages: VectorAssembler / StringIndexer / IndexToString —
+the MLlib stages the reference's pipelines composed around the deep
+transformers."""
+
+import numpy as np
+import pytest
+
+import sparkdl_tpu as sdl
+
+
+def test_vector_assembler_scalars_and_vectors():
+    df = sdl.DataFrame.fromPydict(
+        {"a": [1.0, 2.0], "b": [10, 20],
+         "v": [np.asarray([0.5, 0.6], np.float32),
+               np.asarray([0.7, 0.8], np.float32)]},
+        numPartitions=2)
+    va = sdl.VectorAssembler(inputCols=["a", "v", "b"], outputCol="feat")
+    rows = va.transform(df).collect()
+    np.testing.assert_allclose(rows[0]["feat"], [1.0, 0.5, 0.6, 10.0])
+    np.testing.assert_allclose(rows[1]["feat"], [2.0, 0.7, 0.8, 20.0])
+    with pytest.raises(ValueError, match="inputCols"):
+        sdl.VectorAssembler(outputCol="f").transform(df)
+
+
+def test_string_indexer_frequency_order_and_inverse():
+    df = sdl.DataFrame.fromPydict(
+        {"fruit": ["b", "a", "b", "c", "b", "a"]}, numPartitions=3)
+    model = sdl.StringIndexer(inputCol="fruit", outputCol="idx").fit(df)
+    # frequencyDesc: b(3)=0, a(2)=1, c(1)=2
+    assert model.getOrDefault(model.labels) == ["b", "a", "c"]
+    out = model.transform(df)
+    assert [r["idx"] for r in out.collect()] == [0, 1, 0, 2, 0, 1]
+
+    inv = sdl.IndexToString(inputCol="idx", outputCol="fruit2",
+                            labels=model.getOrDefault(model.labels))
+    back = inv.transform(out)
+    assert [r["fruit2"] for r in back.collect()] == \
+        ["b", "a", "b", "c", "b", "a"]
+
+
+def test_vector_assembler_rejects_nulls_and_handles_fixed_size_list():
+    import pyarrow as pa
+
+    df = sdl.DataFrame.fromArrow(pa.table({"a": pa.array([1.0, None])}))
+    with pytest.raises(ValueError, match="null at row 1"):
+        sdl.VectorAssembler(inputCols=["a"], outputCol="f").transform(df) \
+            .collect()
+
+    fsl = pa.FixedSizeListArray.from_arrays(
+        pa.array([1.0, 2.0, 3.0, 4.0], pa.float32()), 2)
+    df2 = sdl.DataFrame.fromArrow(pa.table({"v": fsl, "s": [7.0, 8.0]}))
+    rows = sdl.VectorAssembler(inputCols=["v", "s"], outputCol="f") \
+        .transform(df2).collect()
+    np.testing.assert_allclose(rows[0]["f"], [1.0, 2.0, 7.0])
+    np.testing.assert_allclose(rows[1]["f"], [3.0, 4.0, 8.0])
+
+
+def test_vector_assembler_keeps_chain_streamable():
+    """Row-wise op tag: an assembler in the chain must not force whole-
+    partition materialization (the O(batchSize) host-memory contract)."""
+    df = sdl.DataFrame.fromPydict(
+        {"x": [float(i) for i in range(12)]}, numPartitions=1)
+    out = sdl.VectorAssembler(inputCols=["x"], outputCol="f").transform(df)
+    assert out._streamable()
+    sizes = [b.num_rows for b in out.iterBatches(4)]
+    assert sizes == [4, 4, 4]
+
+
+def test_string_indexer_handle_invalid_validated_at_set_time():
+    with pytest.raises(TypeError, match="handleInvalid"):
+        sdl.StringIndexer(inputCol="s", outputCol="i",
+                          handleInvalid="skip")
+
+
+def test_string_indexer_nulls_are_invalid_not_labels():
+    df = sdl.DataFrame.fromPydict({"s": ["a", None, "a"]})
+    with pytest.raises(ValueError, match="null in column 's'"):
+        sdl.StringIndexer(inputCol="s", outputCol="i").fit(df)
+    m = sdl.StringIndexer(inputCol="s", outputCol="i",
+                          handleInvalid="keep").fit(df)
+    assert m.getOrDefault(m.labels) == ["a"]  # null excluded from fit
+    assert [r["i"] for r in m.transform(df).collect()] == [0, 1, 0]
+
+
+def test_string_indexer_unseen_labels():
+    train = sdl.DataFrame.fromPydict({"s": ["x", "y"]})
+    test = sdl.DataFrame.fromPydict({"s": ["x", "z"]})
+    model = sdl.StringIndexer(inputCol="s", outputCol="i").fit(train)
+    with pytest.raises(ValueError, match="unseen label 'z'"):
+        model.transform(test).collect()
+    keep = sdl.StringIndexer(inputCol="s", outputCol="i",
+                             handleInvalid="keep").fit(train)
+    assert [r["i"] for r in keep.transform(test).collect()] == [0, 2]
+
+
+def test_feature_stages_persist(tmp_path):
+    df = sdl.DataFrame.fromPydict({"s": ["a", "b", "a"]})
+    model = sdl.StringIndexer(inputCol="s", outputCol="i").fit(df)
+    p = str(tmp_path / "sim")
+    model.save(p)
+    back = sdl.load(p)
+    assert back.getOrDefault(back.labels) == \
+        model.getOrDefault(model.labels)
+    assert [r["i"] for r in back.transform(df).collect()] == [0, 1, 0]
+
+    va = sdl.VectorAssembler(inputCols=["x", "y"], outputCol="f")
+    pv = str(tmp_path / "va")
+    va.save(pv)
+    va2 = sdl.load(pv)
+    d2 = sdl.DataFrame.fromPydict({"x": [1.0], "y": [2.0]})
+    np.testing.assert_allclose(va2.transform(d2).first()["f"], [1.0, 2.0])
+
+
+def test_indexer_in_pipeline_with_assembler():
+    """The reference-era flow: StringIndexer labels + VectorAssembler
+    features → LogisticRegression, all inside one Pipeline."""
+    rng = np.random.RandomState(0)
+    n = 40
+    cls = ["cat" if i % 2 else "dog" for i in range(n)]
+    feats = [rng.randn(3) + (2.0 if c == "cat" else -2.0) for c in cls]
+    df = sdl.DataFrame.fromPydict(
+        {"name": cls,
+         "f": [np.asarray(f, np.float32) for f in feats]})
+    pipe = sdl.Pipeline([
+        sdl.StringIndexer(inputCol="name", outputCol="label"),
+        sdl.VectorAssembler(inputCols=["f"], outputCol="features"),
+        sdl.LogisticRegression(maxIter=80),
+    ])
+    model = pipe.fit(df)
+    preds = model.transform(df).collect()
+    idx = {r["name"]: r["label"] for r in
+           sdl.StringIndexer(inputCol="name", outputCol="label")
+           .fit(df).transform(df).collect()}
+    acc = np.mean([int(r["prediction"]) == idx[r["name"]] for r in preds])
+    assert acc >= 0.95
