@@ -314,9 +314,11 @@ def _worker_featurizer() -> dict:
         breakdown["fetch_s"] = time.perf_counter() - t
     except Exception as e:
         breakdown["error"] = f"{type(e).__name__}: {e}"[:200]
+    from sparkdl_tpu import native as native_mod
     return {"rows_per_sec": rows / dt, "rows": rows, "batch_size": batch,
             "model": model_name, "wall_s": dt,
             "compute_dtype": os.environ.get("BENCH_FEAT_DTYPE", "bfloat16"),
+            "native_packer": native_mod.available(),
             "breakdown": {k: round(v, 3) if isinstance(v, float) else v
                           for k, v in breakdown.items()}}
 
@@ -714,7 +716,8 @@ def main():
     if feat:
         extra["featurizer_rows_per_sec"] = round(feat["rows_per_sec"], 2)
         extra["featurizer_config"] = {
-            k: feat[k] for k in ("rows", "batch_size", "compute_dtype")}
+            k: feat[k] for k in ("rows", "batch_size", "compute_dtype",
+                                 "native_packer")}
         extra["featurizer_breakdown"] = feat.get("breakdown", {})
     elif feat_err:
         extra["featurizer_error"] = feat_err

@@ -42,6 +42,7 @@ from .transformers.feature import (IndexToString, StringIndexer,
                                    StringIndexerModel, VectorAssembler)
 from .udf import (applyUDF, listUDFs, registerGenerationUDF,
                   registerImageUDF, registerKerasImageUDF,
+                  registerSequenceClassificationUDF,
                   registerTextGenerationUDF, registerUDF)
 
 __all__ = [
@@ -67,7 +68,8 @@ __all__ = [
     "BinaryClassificationEvaluator",
     "KerasImageFileEstimator",
     "registerUDF", "registerImageUDF", "registerKerasImageUDF",
-    "registerGenerationUDF", "registerTextGenerationUDF", "applyUDF",
+    "registerGenerationUDF", "registerTextGenerationUDF",
+    "registerSequenceClassificationUDF", "applyUDF",
     "listUDFs",
     "GraphFunction", "IsolatedSession", "XlaInputGraph", "TFInputGraph",
     "buildSpImageConverter", "buildFlattener", "makeGraphUDF",

@@ -61,21 +61,22 @@ class VectorAssembler(Transformer, HasOutputCol):
             for c in cols:
                 arr = batch.column(c)
                 if arr.null_count:
-                    bad = next(i for i, v in enumerate(arr.to_pylist())
-                               if v is None)
                     # Spark's handleInvalid='error' default: a null would
-                    # otherwise silently become NaN in the feature vector
+                    # otherwise silently become NaN in the feature vector.
+                    # (No row index: this op sees streamed sub-batches, so
+                    # a local index would mislead.)
                     raise ValueError(
-                        f"VectorAssembler: column {c!r} has a null at "
-                        f"row {bad}; clean or filter nulls first")
+                        f"VectorAssembler: column {c!r} contains null "
+                        f"values; clean or filter nulls first")
                 if (pa.types.is_list(arr.type)
                         or pa.types.is_large_list(arr.type)
                         or pa.types.is_fixed_size_list(arr.type)):
                     # zero-copy Arrow→ndarray (shared with the tensor
-                    # transformers; handles fixed_size_list too)
-                    a = columnToNdarray(arr, None)
-                    pieces.append(a.reshape(len(a), -1)
-                                  .astype(np.float64))
+                    # transformers); float64 end-to-end — the output
+                    # column type — so no silent float32 rounding
+                    pieces.append(columnToNdarray(arr, None,
+                                                  dtype=np.float64)
+                                  .reshape(len(arr), -1))
                 else:
                     pieces.append(np.asarray(
                         arr.to_pylist(), dtype=np.float64)[:, None])

@@ -27,7 +27,9 @@ def columnToNdarray(column: pa.Array, shape: tuple | None,
     """list<float> / primitive column → (N, *shape) contiguous array."""
     if isinstance(column, pa.ChunkedArray):
         column = column.combine_chunks()
-    if pa.types.is_list(column.type) or pa.types.is_fixed_size_list(column.type):
+    if (pa.types.is_list(column.type)
+            or pa.types.is_large_list(column.type)
+            or pa.types.is_fixed_size_list(column.type)):
         flat = column.flatten().to_numpy(zero_copy_only=False).astype(dtype)
         n = len(column)
         if shape:

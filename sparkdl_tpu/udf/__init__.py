@@ -1,8 +1,10 @@
 from .registry import (applyUDF, listUDFs, registerGenerationUDF,
                        registerImageUDF, registerKerasImageUDF,
+                       registerSequenceClassificationUDF,
                        registerTextGenerationUDF, registerUDF,
                        unregisterUDF)
 
 __all__ = ["registerUDF", "registerImageUDF", "registerKerasImageUDF",
            "registerGenerationUDF", "registerTextGenerationUDF",
+           "registerSequenceClassificationUDF",
            "applyUDF", "listUDFs", "unregisterUDF"]

@@ -42,9 +42,18 @@ def test_vector_assembler_rejects_nulls_and_handles_fixed_size_list():
     import pyarrow as pa
 
     df = sdl.DataFrame.fromArrow(pa.table({"a": pa.array([1.0, None])}))
-    with pytest.raises(ValueError, match="null at row 1"):
+    with pytest.raises(ValueError, match="contains null"):
         sdl.VectorAssembler(inputCols=["a"], outputCol="f").transform(df) \
             .collect()
+
+    # float64 survives end-to-end (no silent float32 squeeze) and
+    # large_list columns work
+    exact = 16777217.0  # 2**24 + 1: not representable in float32
+    ll = pa.array([[exact]], type=pa.large_list(pa.float64()))
+    dfp = sdl.DataFrame.fromArrow(pa.table({"v": ll}))
+    row = sdl.VectorAssembler(inputCols=["v"], outputCol="f") \
+        .transform(dfp).first()
+    assert row["f"][0] == exact
 
     fsl = pa.FixedSizeListArray.from_arrays(
         pa.array([1.0, 2.0, 3.0, 4.0], pa.float32()), 2)

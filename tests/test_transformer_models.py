@@ -541,6 +541,52 @@ def test_generation_udf_streams_without_full_materialization(monkeypatch):
         assert list(r["c"]) == solo[0].tolist()
 
 
+def test_sequence_classification_udf():
+    """The config-4 serving half: ragged token-id columns stream through
+    ONE compiled encoder-classifier program (right-pad + attention mask),
+    predictions equal per-row solo classification."""
+    import sparkdl_tpu as sdl
+    from sparkdl_tpu.models.bert import (BertConfig,
+                                         BertForSequenceClassification)
+    from sparkdl_tpu.udf import (registerSequenceClassificationUDF,
+                                 unregisterUDF)
+
+    cfg = BertConfig.tiny()
+    model = BertForSequenceClassification(cfg, num_classes=3)
+    v = model.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))
+    rng = np.random.RandomState(0)
+    rows = [rng.randint(0, cfg.vocab_size, n).tolist()
+            for n in (8, 3, 12, 5, 7)]
+    df = sdl.DataFrame.fromPydict({"tokens": rows}, numPartitions=2)
+
+    registerSequenceClassificationUDF("cls", model, v, batchRows=3)
+    try:
+        out = sdl.applyUDF(df, "cls", "tokens", "label")
+        got = [r["label"] for r in out.collect()]
+        assert out.numPartitions == df.numPartitions
+    finally:
+        unregisterUDF("cls")
+
+    for toks, lab in zip(rows, got):
+        ids = np.asarray([toks], np.int32)
+        mask = np.ones_like(ids)
+        logits = model.apply(v, jnp.asarray(ids), jnp.asarray(mask))
+        assert int(np.asarray(logits).argmax(-1)[0]) == lab
+
+    # empty and null prompts rejected with the GLOBAL row named
+    bad = sdl.DataFrame.fromPydict({"tokens": [[1, 2], []]})
+    nul = sdl.DataFrame.fromPydict(
+        {"tokens": [[1], [2], [3], None]}, numPartitions=2)
+    registerSequenceClassificationUDF("cls2", model, v, batchRows=2)
+    try:
+        with pytest.raises(ValueError, match="row 1 is an empty"):
+            sdl.applyUDF(bad, "cls2", "tokens", "label")
+        with pytest.raises(ValueError, match="row 3 is null"):
+            sdl.applyUDF(nul, "cls2", "tokens", "label")
+    finally:
+        unregisterUDF("cls2")
+
+
 def test_text_generation_udf_string_columns():
     """registerTextGenerationUDF: string prompts → encode → the streamed
     token UDF → decode, with the prompt stripped from the completion and

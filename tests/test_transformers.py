@@ -214,6 +214,24 @@ def test_keras_transformer_and_image_file_transformer(tmp_path):
     assert len(rows) == 3 and len(rows[0].out) == 3
 
 
+def test_compat_aliases_and_direct_image_udf():
+    """Pin the reference-compat surface: the TF-era names are aliases,
+    and registerImageUDF works standalone (not only through
+    registerKerasImageUDF)."""
+    assert sdl.TFTransformer is sdl.XlaTransformer
+    assert isinstance(sdl.__version__, str) and sdl.__version__
+
+    df, _ = image_df(n=3, parts=1)
+    sdl.registerImageUDF("half8", lambda b: jnp.mean(b, axis=(1, 2)),
+                         inputSize=(8, 8), batchSize=2)
+    try:
+        out = sdl.applyUDF(df, "half8", "image", "m")
+        rows = out.collect()
+        assert len(rows) == 3 and len(rows[0]["m"]) == 3  # mean per channel
+    finally:
+        sdl.udf.unregisterUDF("half8")
+
+
 def test_udf_registry_roundtrip():
     sdl.registerUDF("double_it", lambda b: b * 2.0, batchSize=4)
     assert "double_it" in sdl.listUDFs()
