@@ -62,6 +62,12 @@ import time
 _HERE = os.path.dirname(os.path.abspath(__file__))
 
 
+def _env_flag(name: str) -> bool:
+    """'1'/'true'/'yes' → True; ''/'0'/'false'/'no'/unset → False (a bare
+    bool(getenv) would treat BENCH_REMAT=0 as enabled)."""
+    return os.environ.get(name, "").strip().lower() in ("1", "true", "yes")
+
+
 def _apply_platform_env():
     """Honor JAX_PLATFORMS in workers: the axon sitecustomize sets the
     *config* to "axon,cpu" at plugin registration, which overrides the env
@@ -173,7 +179,8 @@ def _worker_resnet50_train() -> dict:
                 "label": rng.randint(0, 1000, size=(n,)),
             }
             step = ctx.make_train_step(
-                bn_classifier_loss(model, spec.preprocess), mutable=True)
+                bn_classifier_loss(model, spec.preprocess), mutable=True,
+                remat=_env_flag("BENCH_REMAT"))
             sharded = ctx.shard_batch(batch)
             step, state, m, dt_step, flops = _compile_and_time(
                 step, state, sharded, warmup, steps)
@@ -226,6 +233,7 @@ def _worker_resnet50_train() -> dict:
 
         from sparkdl_tpu.ops.flash_attention import auto_attn_fn
         return {"img_s_chip": best["img_s_chip"], "n_chips": ctx.size,
+                "remat": _env_flag("BENCH_REMAT"),
                 "batch_per_chip": best["batch_per_chip"], "steps": steps,
                 "model": model_name, "image_size": img,
                 "step_time_s": best["step_time_s"],

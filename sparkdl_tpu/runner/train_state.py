@@ -84,7 +84,8 @@ def state_sharding(state: TrainState, mesh: Mesh,
 def make_train_step(loss_fn: Callable, mesh: Mesh, data_axis: str = "data",
                     param_rules: Callable | None = None,
                     donate: bool = True, mutable: bool = False,
-                    with_rng: bool = False, rng_seed: int = 0) -> Callable:
+                    with_rng: bool = False, rng_seed: int = 0,
+                    remat: bool = False) -> Callable:
     """Compile an SPMD train step: ``step(state, batch) -> (state, metrics)``.
 
     ``loss_fn(params, apply_fn, batch) -> (loss, aux_dict)``; with
@@ -98,6 +99,12 @@ def make_train_step(loss_fn: Callable, mesh: Mesh, data_axis: str = "data",
     collective in user code. Under this path batch statistics reduce over the
     *global* batch (sync-BN for free: the batch dim is sharded, the mean is
     global).
+
+    ``remat=True`` wraps the loss forward in ``jax.checkpoint``: the
+    backward pass recomputes activations instead of keeping them in HBM —
+    the standard FLOPs-for-memory trade that unlocks larger per-chip
+    batches when activation memory (not weights) is the HBM ceiling. Same
+    gradients either way (it is a scheduling change, not a math change).
     """
     base_key = jax.random.PRNGKey(rng_seed)
 
@@ -119,6 +126,8 @@ def make_train_step(loss_fn: Callable, mesh: Mesh, data_axis: str = "data",
                                             state.apply_fn, batch, **kw)
                 return loss.astype(jnp.float32), (aux, new_ms)
 
+            if remat:
+                loss_wrapped = jax.checkpoint(loss_wrapped)
             (loss, (aux, new_ms)), grads = jax.value_and_grad(
                 loss_wrapped, has_aux=True)(state.params)
             new_state = dataclasses.replace(
@@ -128,6 +137,8 @@ def make_train_step(loss_fn: Callable, mesh: Mesh, data_axis: str = "data",
                 loss, aux = loss_fn(params, state.apply_fn, batch, **kw)
                 return loss.astype(jnp.float32), aux
 
+            if remat:
+                loss_wrapped = jax.checkpoint(loss_wrapped)
             (loss, aux), grads = jax.value_and_grad(
                 loss_wrapped, has_aux=True)(state.params)
             new_state = state.apply_gradients(grads)

@@ -75,8 +75,7 @@ class VectorAssembler(Transformer, HasOutputCol):
                     # transformers); float64 end-to-end — the output
                     # column type — so no silent float32 rounding
                     pieces.append(columnToNdarray(arr, None,
-                                                  dtype=np.float64)
-                                  .reshape(len(arr), -1))
+                                                  dtype=np.float64))
                 else:
                     pieces.append(np.asarray(
                         arr.to_pylist(), dtype=np.float64)[:, None])

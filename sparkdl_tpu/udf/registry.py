@@ -133,41 +133,41 @@ def _make_generation_apply(model, variables, *, max_new_tokens: int = 32,
         raise TypeError(f"eos_id must be an int token id or None, "
                         f"got {eos_id!r}")
 
-    rng_box = [None]
-
-    def compute(prompts, lmax, n_fill):
-        import pyarrow as pa
-        if rng_box[0] is None:
-            rng_box[0] = jax.random.PRNGKey(seed)
-        ids, pads = left_pad_prompts(prompts, pad_to=lmax)
-        n = len(ids)
-        if n_fill:
-            ids = np.concatenate([ids, np.repeat(ids[:1], n_fill, axis=0)])
-            pads = np.concatenate(
-                [pads, np.repeat(pads[:1], n_fill, axis=0)])
-        rng_box[0], key = jax.random.split(rng_box[0])
-        gen = np.asarray(generate(
-            model, variables, ids, max_new_tokens,
-            temperature=temperature, rng=key,
-            pad_to=lmax + max_new_tokens, pad_lens=pads,
-            top_k=top_k, top_p=top_p, eos_id=eos_id))
-        out: list = []
-        for row in range(n):
-            # strip this row's left pads: real prompt + new tokens
-            toks = gen[row, pads[row]:].tolist()
-            if eos_id is not None:
-                # trim the repeated-eos tail, keep one eos
-                plen = len(prompts[row])
-                gen_part = toks[plen:]
-                if eos_id in gen_part:
-                    gen_part = gen_part[:gen_part.index(eos_id) + 1]
-                toks = toks[:plen] + gen_part
-            out.append(toks)
-        return pa.array(out, type=pa.list_(pa.int64()))
-
     def apply(df: DataFrame, inputCol: str, outputCol: str) -> DataFrame:
         import pyarrow as pa
-        rng_box[0] = None  # fresh deterministic stream per applyUDF call
+
+        # per-call key stream: deterministic for a given seed, and no
+        # state shared between concurrent applyUDF calls (reentrant)
+        rng_box = [jax.random.PRNGKey(seed)]
+
+        def compute(prompts, lmax, n_fill):
+            ids, pads = left_pad_prompts(prompts, pad_to=lmax)
+            n = len(ids)
+            if n_fill:
+                ids = np.concatenate(
+                    [ids, np.repeat(ids[:1], n_fill, axis=0)])
+                pads = np.concatenate(
+                    [pads, np.repeat(pads[:1], n_fill, axis=0)])
+            rng_box[0], key = jax.random.split(rng_box[0])
+            gen = np.asarray(generate(
+                model, variables, ids, max_new_tokens,
+                temperature=temperature, rng=key,
+                pad_to=lmax + max_new_tokens, pad_lens=pads,
+                top_k=top_k, top_p=top_p, eos_id=eos_id))
+            out: list = []
+            for row in range(n):
+                # strip this row's left pads: real prompt + new tokens
+                toks = gen[row, pads[row]:].tolist()
+                if eos_id is not None:
+                    # trim the repeated-eos tail, keep one eos
+                    plen = len(prompts[row])
+                    gen_part = toks[plen:]
+                    if eos_id in gen_part:
+                        gen_part = gen_part[:gen_part.index(eos_id) + 1]
+                    toks = toks[:plen] + gen_part
+                out.append(toks)
+            return pa.array(out, type=pa.list_(pa.int64()))
+
         return _streamed_token_apply(df, inputCol, outputCol, batchRows,
                                      compute, pa.list_(pa.int64()))
 

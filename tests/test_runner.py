@@ -88,6 +88,26 @@ class TestTrainStep:
                                        np.asarray(s2.params[k]),
                                        rtol=1e-5, atol=1e-6)
 
+    def test_remat_same_gradients(self, runner):
+        """remat=True recomputes activations in the backward pass — a
+        scheduling change, not a math change: updated params must equal
+        the non-remat step's."""
+        ctx = runner.make_context()
+        params, batch = _make_problem(seed=2)
+        loss_fn = softmax_cross_entropy_loss()
+        tx = optax.sgd(0.1)
+        with ctx.mesh:
+            s1, _ = ctx.make_train_step(loss_fn)(
+                TrainState.create(_linear_apply, params, tx),
+                ctx.shard_batch(batch))
+            s2, _ = ctx.make_train_step(loss_fn, remat=True)(
+                TrainState.create(_linear_apply, params, tx),
+                ctx.shard_batch(batch))
+        for k in ("w", "b"):
+            np.testing.assert_allclose(np.asarray(s1.params[k]),
+                                       np.asarray(s2.params[k]),
+                                       rtol=1e-6, atol=1e-7)
+
     def test_batch_actually_sharded(self, runner):
         """The input batch must land split over the data axis — 8 shards."""
         ctx = runner.make_context()
