@@ -85,7 +85,7 @@ def make_train_step(loss_fn: Callable, mesh: Mesh, data_axis: str = "data",
                     param_rules: Callable | None = None,
                     donate: bool = True, mutable: bool = False,
                     with_rng: bool = False, rng_seed: int = 0,
-                    remat: bool = False) -> Callable:
+                    remat: bool = False, accum_steps: int = 1) -> Callable:
     """Compile an SPMD train step: ``step(state, batch) -> (state, metrics)``.
 
     ``loss_fn(params, apply_fn, batch) -> (loss, aux_dict)``; with
@@ -105,7 +105,23 @@ def make_train_step(loss_fn: Callable, mesh: Mesh, data_axis: str = "data",
     the standard FLOPs-for-memory trade that unlocks larger per-chip
     batches when activation memory (not weights) is the HBM ceiling. Same
     gradients either way (it is a scheduling change, not a math change).
+
+    ``accum_steps=k`` > 1 gradient-accumulates: the batch splits into k
+    equal microbatches scanned sequentially (one microbatch of
+    activations resident at a time — composes with remat), gradients
+    average across them, ONE optimizer update per step. For mean-reduced
+    losses this equals the full-batch gradient exactly. The batch's
+    leading dim must divide by k (and by k x the data-axis size for even
+    shards). Not supported with ``mutable`` (BatchNorm batch stats would
+    silently become last-microbatch stats).
     """
+    if accum_steps > 1 and mutable:
+        raise ValueError(
+            "accum_steps > 1 with mutable=True is not supported: BatchNorm "
+            "statistics would come from single microbatches, silently "
+            "changing the model's normalization semantics")
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     base_key = jax.random.PRNGKey(rng_seed)
 
     def step(state: TrainState, batch):
@@ -132,7 +148,7 @@ def make_train_step(loss_fn: Callable, mesh: Mesh, data_axis: str = "data",
                 loss_wrapped, has_aux=True)(state.params)
             new_state = dataclasses.replace(
                 state.apply_gradients(grads), model_state=new_ms)
-        else:
+        elif accum_steps == 1:
             def loss_wrapped(params):
                 loss, aux = loss_fn(params, state.apply_fn, batch, **kw)
                 return loss.astype(jnp.float32), aux
@@ -141,6 +157,71 @@ def make_train_step(loss_fn: Callable, mesh: Mesh, data_axis: str = "data",
                 loss_wrapped = jax.checkpoint(loss_wrapped)
             (loss, aux), grads = jax.value_and_grad(
                 loss_wrapped, has_aux=True)(state.params)
+            new_state = state.apply_gradients(grads)
+        else:
+            # Gradient accumulation: lax.scan over k microbatches — one
+            # microbatch of activations in flight, grads averaged, one
+            # optimizer update. Equals the full-batch gradient for
+            # mean-reduced losses (any equal-size row partition does).
+            n_shard = int(mesh.shape[data_axis])
+
+            def micro_split(x):
+                if x.shape[0] % accum_steps:
+                    raise ValueError(
+                        f"batch dim {x.shape[0]} not divisible by "
+                        f"accum_steps={accum_steps}")
+                if x.shape[0] % (accum_steps * n_shard) == 0:
+                    # Shard-aligned split: each chip's LOCAL rows divide
+                    # among the k microbatches, so every microbatch stays
+                    # evenly sharded over the data axis with zero
+                    # cross-chip movement (row regrouping is free: the
+                    # loss is mean-reduced, so any equal-size partition
+                    # yields the same averaged gradient).
+                    local = x.shape[0] // (accum_steps * n_shard)
+                    x = x.reshape((n_shard, accum_steps, local)
+                                  + x.shape[1:])
+                    x = jnp.moveaxis(x, 1, 0)
+                    x = x.reshape((accum_steps, n_shard * local)
+                                  + x.shape[3:])
+                    return jax.lax.with_sharding_constraint(
+                        x, NamedSharding(mesh, P(None, data_axis)))
+                # Not enough rows per chip for the aligned split —
+                # contiguous reshape; GSPMD may reshard across chips.
+                return x.reshape((accum_steps, -1) + x.shape[1:])
+
+            micro = jax.tree_util.tree_map(micro_split, batch)
+
+            def micro_loss(params, mb, key):
+                mkw = {"rng": key} if with_rng else {}
+                loss, aux = loss_fn(params, state.apply_fn, mb, **mkw)
+                return loss.astype(jnp.float32), aux
+
+            if remat:
+                micro_loss = jax.checkpoint(micro_loss)
+            grad_fn = jax.value_and_grad(micro_loss, has_aux=True)
+            step_key = kw.get("rng", base_key)
+
+            def body(carry, idx_mb):
+                idx, mb = idx_mb
+                gsum, lsum = carry
+                (loss, aux), g = grad_fn(
+                    state.params, mb, jax.random.fold_in(step_key, idx))
+                # accumulate in f32 whatever the param dtype — k bf16
+                # additions would round away small-gradient contributions
+                gsum = jax.tree_util.tree_map(
+                    lambda s, x: s + x.astype(jnp.float32), gsum, g)
+                return (gsum, lsum + loss), aux
+
+            zeros = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+            (gsum, lsum), auxs = jax.lax.scan(
+                body, (zeros, jnp.zeros((), jnp.float32)),
+                (jnp.arange(accum_steps), micro))
+            grads = jax.tree_util.tree_map(
+                lambda g, p: (g / accum_steps).astype(p.dtype),
+                gsum, state.params)
+            loss = lsum / accum_steps
+            aux = jax.tree_util.tree_map(lambda a: a.mean(axis=0), auxs)
             new_state = state.apply_gradients(grads)
         metrics = dict(loss=loss, **aux)
         return new_state, metrics
@@ -161,7 +242,9 @@ def make_shard_map_step(loss_fn: Callable, mesh: Mesh,
                         donate: bool = True,
                         mutable: bool = False,
                         with_rng: bool = False,
-                        rng_seed: int = 0) -> Callable:
+                        rng_seed: int = 0,
+                        remat: bool = False,
+                        accum_steps: int = 1) -> Callable:
     """The explicit-collective twin of ``make_train_step``.
 
     Runs per-shard forward/backward under ``shard_map`` and averages gradients
@@ -175,7 +258,14 @@ def make_shard_map_step(loss_fn: Callable, mesh: Mesh,
     The implicit ``make_train_step`` instead reduces batch stats over the
     global batch (sync-BN). The two therefore diverge numerically for BN
     models at small per-chip batch; pick by BN semantics, not by style.
+
+    ``remat=True`` composes (jax.checkpoint inside the shard body);
+    ``accum_steps`` is only implemented on the implicit path.
     """
+    if accum_steps != 1:
+        raise ValueError(
+            "accum_steps is not supported with explicit_collectives / "
+            "make_shard_map_step — use the implicit make_train_step path")
     shard_map = jax.shard_map
     base_key = jax.random.PRNGKey(rng_seed)
 
@@ -190,6 +280,8 @@ def make_shard_map_step(loss_fn: Callable, mesh: Mesh,
                                             state.apply_fn, batch, **kw)
                 return loss.astype(jnp.float32), (aux, new_ms)
 
+            if remat:
+                loss_wrapped = jax.checkpoint(loss_wrapped)
             (loss, (aux, new_ms)), grads = jax.value_and_grad(
                 loss_wrapped, has_aux=True)(state.params)
             new_ms = jax.lax.pmean(new_ms, axis_name=data_axis)
@@ -198,6 +290,8 @@ def make_shard_map_step(loss_fn: Callable, mesh: Mesh,
                 loss, aux = loss_fn(params, state.apply_fn, batch, **kw)
                 return loss.astype(jnp.float32), aux
 
+            if remat:
+                loss_wrapped = jax.checkpoint(loss_wrapped)
             (loss, aux), grads = jax.value_and_grad(
                 loss_wrapped, has_aux=True)(state.params)
             new_ms = None

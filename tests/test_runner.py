@@ -108,6 +108,70 @@ class TestTrainStep:
                                        np.asarray(s2.params[k]),
                                        rtol=1e-6, atol=1e-7)
 
+    def test_gradient_accumulation_equals_full_batch(self, runner):
+        """accum_steps=k microbatch scan must produce the SAME update as
+        one full-batch step (mean-reduced loss ⇒ averaged microbatch
+        grads == full grad), also composed with remat."""
+        ctx = runner.make_context()
+        params, batch = _make_problem(seed=3)
+        loss_fn = softmax_cross_entropy_loss()
+        tx = optax.sgd(0.1)
+        with ctx.mesh:
+            full, _ = ctx.make_train_step(loss_fn)(
+                TrainState.create(_linear_apply, params, tx),
+                ctx.shard_batch(batch))
+            acc, m = ctx.make_train_step(loss_fn, accum_steps=4)(
+                TrainState.create(_linear_apply, params, tx),
+                ctx.shard_batch(batch))
+            accr, _ = ctx.make_train_step(loss_fn, accum_steps=4,
+                                          remat=True)(
+                TrainState.create(_linear_apply, params, tx),
+                ctx.shard_batch(batch))
+        for k in ("w", "b"):
+            np.testing.assert_allclose(np.asarray(acc.params[k]),
+                                       np.asarray(full.params[k]),
+                                       rtol=1e-5, atol=1e-6)
+            np.testing.assert_allclose(np.asarray(accr.params[k]),
+                                       np.asarray(full.params[k]),
+                                       rtol=1e-5, atol=1e-6)
+        assert np.isfinite(float(m["loss"]))
+        # shard-aligned split path: batch divisible by k x shards (the
+        # zero-reshard fast path; the 16-row case above exercises the
+        # contiguous fallback)
+        rng = np.random.RandomState(9)
+        big = {"image": rng.randn(64, 4).astype(np.float32),
+               "label": rng.randint(0, 3, (64,))}
+        bparams = {"w": rng.randn(4, 3).astype(np.float32) * 0.1,
+                   "b": np.zeros(3, np.float32)}
+        with ctx.mesh:
+            bf, _ = ctx.make_train_step(loss_fn)(
+                TrainState.create(_linear_apply, bparams, tx),
+                ctx.shard_batch(big))
+            ba, _ = ctx.make_train_step(loss_fn, accum_steps=4)(
+                TrainState.create(_linear_apply, bparams, tx),
+                ctx.shard_batch(big))
+        for k in ("w", "b"):
+            np.testing.assert_allclose(np.asarray(ba.params[k]),
+                                       np.asarray(bf.params[k]),
+                                       rtol=1e-5, atol=1e-6)
+        with pytest.raises(ValueError, match="mutable"):
+            make_train_step(loss_fn, ctx.mesh, mutable=True, accum_steps=2)
+        with pytest.raises(ValueError, match="accum_steps"):
+            make_train_step(loss_fn, ctx.mesh, accum_steps=0)
+        # explicit-collective path: remat composes, accum raises clearly
+        with ctx.mesh:
+            er, _ = ctx.make_train_step(loss_fn, explicit_collectives=True,
+                                        remat=True)(
+                TrainState.create(_linear_apply, params, tx),
+                ctx.shard_batch(batch))
+        for k in ("w", "b"):
+            np.testing.assert_allclose(np.asarray(er.params[k]),
+                                       np.asarray(full.params[k]),
+                                       rtol=1e-5, atol=1e-6)
+        with pytest.raises(ValueError, match="explicit_collectives"):
+            ctx.make_train_step(loss_fn, explicit_collectives=True,
+                                accum_steps=2)
+
     def test_batch_actually_sharded(self, runner):
         """The input batch must land split over the data axis — 8 shards."""
         ctx = runner.make_context()
