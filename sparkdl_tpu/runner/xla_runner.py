@@ -186,7 +186,8 @@ class RunnerContext:
             eval_fn: Callable | None = None, eval_data: Iterable | None = None,
             eval_every: int = 0, checkpoint_every: int = 0,
             log_every: int = 10, explicit_collectives: bool = False,
-            resume: bool = True, profile_dir: str | None = None) -> dict:
+            resume: bool = True, profile_dir: str | None = None,
+            remat: bool = False, accum_steps: int = 1) -> dict:
         """Run a full training loop; returns {state, meter, history}.
 
         Streams ``data`` (iterator of host-numpy batch dicts), shards each
@@ -210,7 +211,8 @@ class RunnerContext:
 
         step_fn = self.make_train_step(
             loss_fn, explicit_collectives=explicit_collectives,
-            mutable=mutable, with_rng=with_rng)
+            mutable=mutable, with_rng=with_rng, remat=remat,
+            accum_steps=accum_steps)
         meter = self.meter()
         logger = metrics_lib.MetricsLogger(self.log_dir)
         eval_step = self.make_eval_step(eval_fn) if eval_fn else None
@@ -225,6 +227,24 @@ class RunnerContext:
                     batch = next(data_it)
                 except StopIteration:
                     break
+                if accum_steps > 1:
+                    # A ragged tail batch can't split into k equal
+                    # microbatches — crop to the largest divisible size
+                    # (dropping < accum_steps leftover rows) rather than
+                    # aborting the run at its last step.
+                    lead = len(jax.tree_util.tree_leaves(batch)[0])
+                    keep = (lead // accum_steps) * accum_steps
+                    if keep == 0:
+                        log.warning(
+                            "skipping tail batch of %d rows "
+                            "(< accum_steps=%d)", lead, accum_steps)
+                        continue
+                    if keep != lead:
+                        log.warning(
+                            "cropping tail batch %d -> %d rows for "
+                            "accum_steps=%d", lead, keep, accum_steps)
+                        batch = jax.tree_util.tree_map(
+                            lambda x: x[:keep], batch)
                 # Multi-process: `data` yields LOCAL shards (shard_batch
                 # contract) — the global step consumed n * process_count
                 # examples, and per-chip rates divide by GLOBAL chip count.
