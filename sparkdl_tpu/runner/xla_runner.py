@@ -229,20 +229,27 @@ class RunnerContext:
                     break
                 if accum_steps > 1:
                     # A ragged tail batch can't split into k equal
-                    # microbatches — crop to the largest divisible size
-                    # (dropping < accum_steps leftover rows) rather than
-                    # aborting the run at its last step.
+                    # microbatches — crop to the largest size divisible
+                    # by k AND the local device count (so the cropped
+                    # batch still shards AND keeps micro_split's shard-
+                    # aligned fast path), dropping the leftover rows
+                    # rather than aborting the run at its last step.
+                    import math as _math
+                    div = _math.lcm(accum_steps, self.local_device_count)
                     lead = len(jax.tree_util.tree_leaves(batch)[0])
-                    keep = (lead // accum_steps) * accum_steps
+                    keep = (lead // div) * div
                     if keep == 0:
                         log.warning(
-                            "skipping tail batch of %d rows "
-                            "(< accum_steps=%d)", lead, accum_steps)
+                            "skipping tail batch of %d rows (< "
+                            "accum_steps x local devices = %d)",
+                            lead, div)
                         continue
                     if keep != lead:
                         log.warning(
                             "cropping tail batch %d -> %d rows for "
-                            "accum_steps=%d", lead, keep, accum_steps)
+                            "accum_steps=%d x %d local devices",
+                            lead, keep, accum_steps,
+                            self.local_device_count)
                         batch = jax.tree_util.tree_map(
                             lambda x: x[:keep], batch)
                 # Multi-process: `data` yields LOCAL shards (shard_batch

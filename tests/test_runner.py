@@ -172,6 +172,31 @@ class TestTrainStep:
             ctx.make_train_step(loss_fn, explicit_collectives=True,
                                 accum_steps=2)
 
+    def test_fit_accum_crops_ragged_tail(self, runner):
+        """fit(accum_steps=k) must survive a data iterator whose tail
+        batches are not divisible by k x local devices: crop (and skip
+        tiny leftovers), never abort at the run's last step."""
+        def apply_fn(p, x):
+            return x @ p["w"]
+
+        rng = np.random.RandomState(4)
+        params = {"w": rng.randn(4, 3).astype(np.float32) * 0.1}
+
+        def data():
+            for nrows in (32, 20, 3):  # full, ragged (crop), tiny (skip)
+                yield {"image": rng.randn(nrows, 4).astype(np.float32),
+                       "label": rng.randint(0, 3, (nrows,))}
+
+        res = runner.run(lambda ctx: ctx.fit(
+            loss_fn=softmax_cross_entropy_loss(), params=params,
+            tx=optax.sgd(0.1), apply_fn=apply_fn, data=data(),
+            num_steps=3, log_every=1, accum_steps=2))
+        steps = [h["step"] for h in res["history"]]
+        # 32 runs whole; 20 crops to 16 (lcm(2, 8 devices) = 8);
+        # 3 is skipped entirely -> two optimizer steps happened
+        assert steps == [1, 2]
+        assert all(np.isfinite(h["loss"]) for h in res["history"])
+
     def test_batch_actually_sharded(self, runner):
         """The input batch must land split over the data axis — 8 shards."""
         ctx = runner.make_context()
