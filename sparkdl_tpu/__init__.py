@@ -38,7 +38,8 @@ from .transformers import (DeepImageFeaturizer, DeepImagePredictor,
                            XlaImageTransformer, XlaTransformer)
 from .runner import (CheckpointManager, RunnerContext, TrainState, XlaRunner,
                      make_shard_map_step, make_train_step)
-from .transformers.feature import (IndexToString, StringIndexer,
+from .transformers.feature import (IndexToString, StandardScaler,
+                                   StandardScalerModel, StringIndexer,
                                    StringIndexerModel, VectorAssembler)
 from .udf import (applyUDF, listUDFs, registerGenerationUDF,
                   registerImageUDF, registerKerasImageUDF,
@@ -61,7 +62,7 @@ __all__ = [
     "KerasTransformer",
     "LogisticRegression", "LogisticRegressionModel",
     "VectorAssembler", "StringIndexer", "StringIndexerModel",
-    "IndexToString",
+    "IndexToString", "StandardScaler", "StandardScalerModel",
     "ParamGridBuilder", "CrossValidator", "CrossValidatorModel",
     "TrainValidationSplit", "TrainValidationSplitModel",
     "MulticlassClassificationEvaluator", "RegressionEvaluator",

@@ -1,10 +1,11 @@
-"""Feature-engineering stages: VectorAssembler, StringIndexer, IndexToString.
+"""Feature-engineering stages: VectorAssembler, StringIndexer,
+StandardScaler, IndexToString.
 
 The reference's pipelines leaned on Spark MLlib feature stages around the
 deep-learning transformers (StringIndexer for labels, VectorAssembler to
 join feature columns before a shallow learner — e.g. the upstream README's
 ``Pipeline([featurizer, lr])`` flows; SURVEY.md §1-L3). There is no JVM
-MLlib here, so the framework carries the three stages those flows need,
+MLlib here, so the framework carries the stages those flows need,
 with the same Params surface and fit/transform semantics.
 """
 
@@ -174,6 +175,121 @@ class StringIndexerModel(Model, HasInputCol, HasOutputCol):
                 f"{unseen})")
 
         return dataset.withColumn(out_col, to_index, [col])
+
+
+class StandardScaler(Estimator, HasInputCol, HasOutputCol):
+    """Fit per-dimension mean/std over a vector column; transform
+    standardizes (Spark MLlib surface: withMean/withStd flags, std uses
+    the unbiased N-1 denominator like Spark)."""
+
+    withMean = Param(Params, "withMean", "subtract the mean",
+                     TypeConverters.toBoolean)
+    withStd = Param(Params, "withStd", "divide by the std",
+                    TypeConverters.toBoolean)
+
+    @keyword_only
+    def __init__(self, inputCol=None, outputCol=None, withMean=None,
+                 withStd=None):
+        super().__init__()
+        self._setDefault(withMean=False, withStd=True)
+        self._set(**self._input_kwargs)
+
+    @keyword_only
+    def setParams(self, inputCol=None, outputCol=None, withMean=None,
+                  withStd=None):
+        return self._set(**self._input_kwargs)
+
+    def _fit(self, dataset: DataFrame) -> "StandardScalerModel":
+        from .tensor import columnToNdarray
+        col = self.getInputCol()
+        # single streaming pass, Welford/Chan parallel merge — a raw
+        # sum-of-squares accumulator cancels catastrophically for
+        # large-mean data (timestamp-scale values would fit std=0)
+        n = 0
+        mean = None
+        m2 = None
+        for batch in dataset.iterPartitions():
+            if batch.num_rows == 0:
+                continue
+            arr = batch.column(col)
+            if arr.null_count:
+                raise ValueError(f"StandardScaler: column {col!r} "
+                                 f"contains null values")
+            x = columnToNdarray(arr, None, dtype=np.float64)
+            bn = len(x)
+            bmean = x.mean(0)
+            bm2 = ((x - bmean) ** 2).sum(0)
+            if n == 0:
+                n, mean, m2 = bn, bmean, bm2
+            else:
+                delta = bmean - mean
+                tot = n + bn
+                mean = mean + delta * (bn / tot)
+                m2 = m2 + bm2 + delta * delta * (n * bn / tot)
+                n = tot
+        if n == 0:
+            raise ValueError("Cannot fit StandardScaler on an empty "
+                             "DataFrame")
+        var = m2 / max(n - 1, 1)  # unbiased (N-1), like Spark
+        std = np.sqrt(np.maximum(var, 0.0))
+        model = StandardScalerModel(mean=mean.tolist(), std=std.tolist())
+        model._set(inputCol=col, outputCol=self.getOutputCol(),
+                   withMean=self.getOrDefault(self.withMean),
+                   withStd=self.getOrDefault(self.withStd))
+        return model
+
+
+class StandardScalerModel(Model, HasInputCol, HasOutputCol):
+    withMean = Param(Params, "withMean", "subtract the mean",
+                     TypeConverters.toBoolean)
+    withStd = Param(Params, "withStd", "divide by the std",
+                    TypeConverters.toBoolean)
+    mean = Param(Params, "mean", "per-dimension mean",
+                 TypeConverters.toListFloat)
+    std = Param(Params, "std", "per-dimension std (N-1)",
+                TypeConverters.toListFloat)
+
+    @keyword_only
+    def __init__(self, inputCol=None, outputCol=None, withMean=None,
+                 withStd=None, mean=None, std=None):
+        super().__init__()
+        self._setDefault(withMean=False, withStd=True)
+        self._set(**self._input_kwargs)
+
+    def _transform(self, dataset: DataFrame) -> DataFrame:
+        from .tensor import columnToNdarray
+        col = self.getInputCol()
+        out_col = self.getOutputCol()
+        mean = np.asarray(self.getOrDefault(self.mean))
+        std = np.asarray(self.getOrDefault(self.std))
+        sub_mean = self.getOrDefault(self.withMean)
+        div_std = self.getOrDefault(self.withStd)
+        # Spark semantics: a zero-std dimension SCALES BY 0 (output 0.0),
+        # it does not pass the raw value through.
+        factor = np.where(std > 0, 1.0 / np.where(std > 0, std, 1.0), 0.0)
+
+        def op(batch: pa.RecordBatch) -> pa.RecordBatch:
+            if batch.num_rows == 0:
+                return _set_column(batch, out_col, pa.array(
+                    [], type=pa.list_(pa.float64())))
+            arr = batch.column(col)
+            if arr.null_count:
+                raise ValueError(f"StandardScalerModel: column {col!r} "
+                                 f"contains null values")
+            x = columnToNdarray(arr, None, dtype=np.float64)
+            if x.shape[1:] != mean.shape:
+                raise ValueError(
+                    f"StandardScalerModel fitted on {mean.shape[0]} dims, "
+                    f"got {x.shape[1:]} in column {col!r}")
+            if sub_mean:
+                x = x - mean
+            if div_std:
+                x = x * factor
+            return _set_column(batch, out_col,
+                               pa.array(list(x), type=pa.list_(
+                                   pa.float64())))
+
+        return dataset.mapBatches(_row_wise_op(op))
 
 
 class IndexToString(Transformer, HasInputCol, HasOutputCol):

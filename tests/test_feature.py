@@ -120,6 +120,76 @@ def test_feature_stages_persist(tmp_path):
     np.testing.assert_allclose(va2.transform(d2).first()["f"], [1.0, 2.0])
 
 
+def test_standard_scaler():
+    rng = np.random.RandomState(0)
+    X = rng.randn(50, 3) * [2.0, 5.0, 0.0] + [1.0, -3.0, 7.0]  # dim 2 const
+    df = sdl.DataFrame.fromPydict(
+        {"v": [np.asarray(x, np.float64) for x in X]}, numPartitions=4)
+
+    m = sdl.StandardScaler(inputCol="v", outputCol="s", withMean=True,
+                           withStd=True).fit(df)
+    np.testing.assert_allclose(m.getOrDefault(m.mean), X.mean(0),
+                               atol=1e-9)
+    np.testing.assert_allclose(m.getOrDefault(m.std), X.std(0, ddof=1),
+                               atol=1e-9)
+    out = np.stack([np.asarray(r["s"]) for r in m.transform(df).collect()])
+    np.testing.assert_allclose(out.mean(0), [0, 0, 0], atol=1e-9)
+    np.testing.assert_allclose(out.std(0, ddof=1)[:2], [1, 1], atol=1e-9)
+    # constant dimension: centered but NOT divided by zero
+    assert np.isfinite(out).all() and np.allclose(out[:, 2], 0.0)
+
+    # default flags match Spark: std only, no centering; zero-std dims
+    # SCALE BY 0 (Spark semantics), not pass-through
+    m2 = sdl.StandardScaler(inputCol="v", outputCol="s").fit(df)
+    out2 = np.stack([np.asarray(r["s"])
+                     for r in m2.transform(df).collect()])
+    np.testing.assert_allclose(out2.mean(0)[:2],
+                               X.mean(0)[:2] / X.std(0, ddof=1)[:2],
+                               atol=1e-9)
+    np.testing.assert_allclose(out2[:, 2], 0.0)
+
+    # numerically stable at large means (a sum-of-squares accumulator
+    # would cancel to std=0 here)
+    big = 1.7e12 + rng.randn(100) * 987.5
+    bdf = sdl.DataFrame.fromPydict(
+        {"v": [np.asarray([x], np.float64) for x in big]},
+        numPartitions=5)
+    mb = sdl.StandardScaler(inputCol="v", outputCol="s").fit(bdf)
+    np.testing.assert_allclose(mb.getOrDefault(mb.std),
+                               [big.std(ddof=1)], rtol=1e-6)
+
+    # empty partitions stream through transform; nulls error clearly
+    import pyarrow as pa
+    empty_part = m.transform(df.filter(lambda r: False))
+    assert empty_part.count() == 0
+    ndf = sdl.DataFrame.fromArrow(pa.table(
+        {"v": pa.array([[1.0, 2.0, 3.0], None],
+                       type=pa.list_(pa.float64()))}))
+    with pytest.raises(ValueError, match="contains null"):
+        m.transform(ndf).collect()
+
+    with pytest.raises(ValueError, match="empty"):
+        sdl.StandardScaler(inputCol="v", outputCol="s").fit(
+            df.filter(lambda r: False))
+    with pytest.raises(ValueError, match="dims"):
+        bad = sdl.DataFrame.fromPydict(
+            {"v": [np.zeros(5, np.float64)]})
+        m.transform(bad).collect()
+
+
+def test_standard_scaler_persists(tmp_path):
+    df = sdl.DataFrame.fromPydict(
+        {"v": [np.asarray([1.0, 2.0]), np.asarray([3.0, 6.0])]})
+    m = sdl.StandardScaler(inputCol="v", outputCol="s",
+                           withMean=True).fit(df)
+    p = str(tmp_path / "scaler")
+    m.save(p)
+    back = sdl.load(p)
+    a = [r["s"] for r in m.transform(df).collect()]
+    b = [r["s"] for r in back.transform(df).collect()]
+    np.testing.assert_allclose(a, b)
+
+
 def test_indexer_in_pipeline_with_assembler():
     """The reference-era flow: StringIndexer labels + VectorAssembler
     features → LogisticRegression, all inside one Pipeline."""
