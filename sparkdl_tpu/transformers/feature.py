@@ -216,6 +216,8 @@ class StandardScaler(Estimator, HasInputCol, HasOutputCol):
                 raise ValueError(f"StandardScaler: column {col!r} "
                                  f"contains null values")
             x = columnToNdarray(arr, None, dtype=np.float64)
+            if x.ndim == 1:  # plain numeric column → 1-dim vectors
+                x = x[:, None]
             bn = len(x)
             bmean = x.mean(0)
             bm2 = ((x - bmean) ** 2).sum(0)
@@ -266,7 +268,8 @@ class StandardScalerModel(Model, HasInputCol, HasOutputCol):
         div_std = self.getOrDefault(self.withStd)
         # Spark semantics: a zero-std dimension SCALES BY 0 (output 0.0),
         # it does not pass the raw value through.
-        factor = np.where(std > 0, 1.0 / np.where(std > 0, std, 1.0), 0.0)
+        factor = np.divide(1.0, std, out=np.zeros_like(std),
+                           where=std > 0)
 
         def op(batch: pa.RecordBatch) -> pa.RecordBatch:
             if batch.num_rows == 0:
@@ -277,6 +280,8 @@ class StandardScalerModel(Model, HasInputCol, HasOutputCol):
                 raise ValueError(f"StandardScalerModel: column {col!r} "
                                  f"contains null values")
             x = columnToNdarray(arr, None, dtype=np.float64)
+            if x.ndim == 1:  # plain numeric column → 1-dim vectors
+                x = x[:, None]
             if x.shape[1:] != mean.shape:
                 raise ValueError(
                     f"StandardScalerModel fitted on {mean.shape[0]} dims, "

@@ -177,6 +177,17 @@ def test_standard_scaler():
         m.transform(bad).collect()
 
 
+def test_standard_scaler_scalar_column():
+    """Plain numeric columns work as 1-dim vectors (VectorAssembler in the
+    same flow accepts scalars, so the scaler must too)."""
+    df = sdl.DataFrame.fromPydict({"x": [1.0, 2.0, 3.0, 4.0]})
+    m = sdl.StandardScaler(inputCol="x", outputCol="s",
+                           withMean=True).fit(df)
+    out = np.asarray([r["s"] for r in m.transform(df).collect()])
+    np.testing.assert_allclose(out.mean(), 0.0, atol=1e-12)
+    np.testing.assert_allclose(out.std(ddof=1), 1.0, atol=1e-12)
+
+
 def test_standard_scaler_persists(tmp_path):
     df = sdl.DataFrame.fromPydict(
         {"v": [np.asarray([1.0, 2.0]), np.asarray([3.0, 6.0])]})

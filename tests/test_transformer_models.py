@@ -811,6 +811,33 @@ class TestFlashPrefill:
         got = np.asarray(generate(sp_model, v, ids, 5))
         np.testing.assert_array_equal(got, ref)
 
+    def test_tensor_parallel_serving(self):
+        """TP-sharded generation: Megatron-pattern param shards over the
+        model axis (how a too-big-for-one-chip Llama serves on a slice).
+        The jitted prefill/decode honor the input shardings — XLA inserts
+        the collectives; tokens must equal the unsharded run, including
+        the EOS while_loop path."""
+        from sparkdl_tpu.core import runtime
+        from sparkdl_tpu.models.llama import LlamaModel, generate
+        from sparkdl_tpu.parallel import shard_params, transformer_tp_rules
+
+        cfg, dense_model, v = self._setup()
+        ids = np.random.RandomState(10).randint(
+            0, cfg.vocab_size, (2, 12)).astype(np.int32)
+        ref = np.asarray(generate(dense_model, v, ids, 6))
+
+        mesh = runtime.make_mesh({"data": 2, "model": 4})
+        placed = shard_params(v, mesh, transformer_tp_rules())
+        got = np.asarray(generate(dense_model, placed, ids, 6))
+        np.testing.assert_array_equal(got, ref)
+
+        eos = int(ref[0, 12])
+        out, n_steps = generate(dense_model, placed,
+                                np.repeat(ids[:1], 2, 0), 6,
+                                eos_id=eos, return_steps=True)
+        assert n_steps < 6
+        assert (np.asarray(out)[:, 12:] == eos).all()
+
     def test_sequence_parallel_prefill_via_ulysses(self):
         """Ulysses all-to-all prefill: heads scatter, sequence gathers —
         same serving contract as the ring test, different collective."""
