@@ -69,17 +69,12 @@ class VectorAssembler(Transformer, HasOutputCol):
                     raise ValueError(
                         f"VectorAssembler: column {c!r} contains null "
                         f"values; clean or filter nulls first")
-                if (pa.types.is_list(arr.type)
-                        or pa.types.is_large_list(arr.type)
-                        or pa.types.is_fixed_size_list(arr.type)):
-                    # zero-copy Arrow→ndarray (shared with the tensor
-                    # transformers); float64 end-to-end — the output
-                    # column type — so no silent float32 rounding
-                    pieces.append(columnToNdarray(arr, None,
-                                                  dtype=np.float64))
-                else:
-                    pieces.append(np.asarray(
-                        arr.to_pylist(), dtype=np.float64)[:, None])
+                # zero-copy Arrow→ndarray (shared with the tensor
+                # transformers); float64 end-to-end — the output column
+                # type — so no silent float32 rounding; scalar columns
+                # promote to (N, 1)
+                pieces.append(columnToNdarray(arr, None, dtype=np.float64,
+                                              atleast_2d=True))
             flat = np.concatenate(pieces, axis=1)
             return _set_column(batch, out_col,
                                pa.array(list(flat), type=pa.list_(
@@ -215,9 +210,8 @@ class StandardScaler(Estimator, HasInputCol, HasOutputCol):
             if arr.null_count:
                 raise ValueError(f"StandardScaler: column {col!r} "
                                  f"contains null values")
-            x = columnToNdarray(arr, None, dtype=np.float64)
-            if x.ndim == 1:  # plain numeric column → 1-dim vectors
-                x = x[:, None]
+            x = columnToNdarray(arr, None, dtype=np.float64,
+                                atleast_2d=True)
             bn = len(x)
             bmean = x.mean(0)
             bm2 = ((x - bmean) ** 2).sum(0)
@@ -279,9 +273,8 @@ class StandardScalerModel(Model, HasInputCol, HasOutputCol):
             if arr.null_count:
                 raise ValueError(f"StandardScalerModel: column {col!r} "
                                  f"contains null values")
-            x = columnToNdarray(arr, None, dtype=np.float64)
-            if x.ndim == 1:  # plain numeric column → 1-dim vectors
-                x = x[:, None]
+            x = columnToNdarray(arr, None, dtype=np.float64,
+                                atleast_2d=True)
             if x.shape[1:] != mean.shape:
                 raise ValueError(
                     f"StandardScalerModel fitted on {mean.shape[0]} dims, "

@@ -23,8 +23,12 @@ from .xla_image import arrayColumnToArrow
 
 
 def columnToNdarray(column: pa.Array, shape: tuple | None,
-                    dtype=np.float32) -> np.ndarray:
-    """list<float> / primitive column → (N, *shape) contiguous array."""
+                    dtype=np.float32, atleast_2d: bool = False) -> np.ndarray:
+    """list<float> / primitive column → (N, *shape) contiguous array.
+
+    ``atleast_2d``: promote a plain numeric column to (N, 1) — callers
+    that treat rows as vectors (feature stages) set this so scalar
+    columns work wherever vector columns do."""
     if isinstance(column, pa.ChunkedArray):
         column = column.combine_chunks()
     if (pa.types.is_list(column.type)
@@ -40,7 +44,9 @@ def columnToNdarray(column: pa.Array, shape: tuple | None,
         return np.ascontiguousarray(flat.reshape(n, -1) if n else
                                     flat.reshape(0, 0))
     arr = column.to_numpy(zero_copy_only=False).astype(dtype)
-    return arr.reshape((len(arr),) + tuple(shape)) if shape else arr
+    if shape:
+        return arr.reshape((len(arr),) + tuple(shape))
+    return arr[:, None] if atleast_2d else arr
 
 
 class XlaTransformer(PicklesCallableParams, Transformer, HasInputCol,

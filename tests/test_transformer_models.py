@@ -941,6 +941,30 @@ def test_generation_udf_eos_across_chunks():
         assert list(r["c"]) == prompt + [eos]
 
 
+def test_generation_eos_with_sampling():
+    """The while_loop EOS path composes with temperature/top-k/top-p
+    sampling: deterministic per key, correct shapes, done rows pinned to
+    eos, and the same key reproduces the same tokens."""
+    from sparkdl_tpu.models.llama import LlamaConfig, LlamaModel, generate
+
+    cfg = LlamaConfig.tiny()
+    model = LlamaModel(cfg)
+    ids = np.asarray([[1, 2, 3], [4, 5, 6]], np.int32)
+    v = model.init(jax.random.PRNGKey(0), ids)
+    kw = dict(temperature=0.9, top_k=20, top_p=0.95,
+              rng=jax.random.PRNGKey(7), eos_id=5)
+    out1, s1 = generate(model, v, ids, 12, return_steps=True, **kw)
+    out2, s2 = generate(model, v, ids, 12, return_steps=True, **kw)
+    out1, out2 = np.asarray(out1), np.asarray(out2)
+    np.testing.assert_array_equal(out1, out2)  # key-deterministic
+    assert s1 == s2 and out1.shape == (2, 15)
+    for r in range(2):
+        tail = out1[r, 3:]
+        if (tail == 5).any():  # once eos appears, it repeats to the end
+            first = int(np.argmax(tail == 5))
+            assert (tail[first:] == 5).all()
+
+
 def test_generation_eos_early_exit_stops_decode_steps():
     """Compute-side early stop (round-3 verdict Next #6): a batch whose
     rows all emit eos at step k executes ~k decode-loop iterations, not
