@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# Round-long TPU liveness probe loop (VERDICT r4 task #1).
+#
+# Probes the axon backend every PROBE_INTERVAL_S (default 600s) with a
+# PROBE_TIMEOUT_S (default 120s) timeout, appending one line per attempt to
+# PROBE_LOG at the repo root:
+#   <iso8601> <up|down|error> <elapsed_s>[ <detail>]
+# On the FIRST success it immediately runs scripts/measure_on_tpu.sh, saving
+# stdout to BENCH_TPU_MEASURED.json and the full log to MEASURE_LOG, then
+# keeps probing (cheaply) so the log also records how long the window lasted.
+#
+# Usage: nohup bash scripts/probe_loop.sh >/dev/null 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+
+INTERVAL="${PROBE_INTERVAL_S:-600}"
+TIMEOUT="${PROBE_TIMEOUT_S:-120}"
+LOG="PROBE_LOG"
+MEASURED_MARK=".probe_measured"
+
+while true; do
+    start=$(date +%s)
+    out=$(timeout "$TIMEOUT" python -c "import jax; d=jax.devices(); print(len(d), d[0].platform, getattr(d[0],'device_kind','?'))" 2>&1)
+    rc=$?
+    elapsed=$(( $(date +%s) - start ))
+    ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+    if [ $rc -eq 0 ]; then
+        echo "$ts up ${elapsed}s $(echo "$out" | tail -1)" >> "$LOG"
+        if [ ! -f "$MEASURED_MARK" ]; then
+            echo "$ts measuring" >> "$LOG"
+            bash scripts/measure_on_tpu.sh > BENCH_TPU_MEASURED.json 2> MEASURE_LOG
+            mrc=$?
+            echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) measure_done rc=$mrc" >> "$LOG"
+            [ $mrc -eq 0 ] && touch "$MEASURED_MARK"
+        fi
+    elif [ $rc -eq 124 ]; then
+        echo "$ts down ${elapsed}s probe-hung" >> "$LOG"
+    else
+        echo "$ts down ${elapsed}s rc=$rc $(echo "$out" | grep -v Warning | tail -1 | cut -c1-120)" >> "$LOG"
+    fi
+    sleep "$INTERVAL"
+done

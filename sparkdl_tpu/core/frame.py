@@ -374,7 +374,14 @@ class DataFrame:
 
     def show(self, n: int = 20, truncate: int = 20) -> None:
         """Spark-style table print of the first ``n`` rows. ``truncate``:
-        max cell width (0 disables). Materializes only ``take(n)``."""
+        max cell width; 0/False disables, True means the Spark default of
+        20 (bool is an int subclass — without normalizing, True would hit
+        the <4 prefix branch and cut every cell to one char).
+        Materializes only ``take(n)``."""
+        if truncate is True:
+            truncate = 20
+        elif truncate is False:
+            truncate = 0
         rows = self.take(n)
         cols = self.columns
 

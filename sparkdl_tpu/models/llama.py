@@ -136,7 +136,7 @@ class LlamaAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, decode: bool = False, pad_lens=None,
-                 first_chunk: bool = True):
+                 first_chunk: bool = False):
         c, d = self.cfg, self.dtype
         B, S, _ = x.shape
         hd = c.head_dim
@@ -220,9 +220,10 @@ class LlamaAttention(nn.Module):
                 # kv_mask equals the masked dense-vs-cache compute,
                 # without materializing O(S·max_len) scores (flash), or
                 # sharding the S^2 compute over the sp axis (ring).
-                # A chunked multi-call prefill must attend earlier cache
-                # too — callers pass first_chunk=False for chunks after
-                # the first, which takes the dense path.
+                # Gated on the EXPLICIT first_chunk=True opt-in (only
+                # _prefill passes it): a chunked multi-call prefill must
+                # attend earlier cache too, so the default takes the
+                # dense full-cache path.
                 flash = (prefill_attn_fn(valid_extra is not None)
                          if S > 1 and first_chunk else None)
                 o = None
@@ -318,7 +319,7 @@ class LlamaLayer(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, decode: bool = False, pad_lens=None,
-                 first_chunk: bool = True):
+                 first_chunk: bool = False):
         c = self.cfg
         x = x + LlamaAttention(c, self.dtype, self.attn_fn, name="attn")(
             RMSNorm(c.rms_norm_eps, name="attn_norm")(x), positions, decode,
@@ -336,13 +337,14 @@ class LlamaModel(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, decode: bool = False, pad_lens=None,
-                 first_chunk: bool = True):
-        """``first_chunk`` (decode mode, static): True when this apply()
-        writes at cache index 0 — generate()'s single-call prefill, the
-        only prefill shape this framework issues. Callers implementing a
-        chunked multi-call prefill MUST pass False for every chunk after
-        the first so attention sees earlier cache (the flash fast path is
-        square over the current chunk only)."""
+                 first_chunk: bool = False):
+        """``first_chunk`` (decode mode, static): True ONLY when this
+        apply() writes at cache index 0 — generate()'s single-call prefill
+        passes it explicitly (``_prefill``). It enables the square flash
+        fast path, which attends over the current chunk alone; at any
+        other cache index that would silently ignore earlier cache, so
+        the default is False and unaware multi-call chunked-prefill
+        callers get the (correct) dense attention over the full cache."""
         c = self.cfg
         if pad_lens is not None and not decode:
             raise ValueError(
@@ -416,7 +418,7 @@ def _prefill(model, params, prompt_ids, cache, pad_lens=None):
     prompt length — the newest real token is always the last position."""
     logits, mut = model.apply({"params": params, "cache": cache},
                               prompt_ids, decode=True, pad_lens=pad_lens,
-                              mutable=["cache"])
+                              first_chunk=True, mutable=["cache"])
     return logits[:, -1].astype(jnp.float32), mut["cache"]
 
 

@@ -229,27 +229,32 @@ class RunnerContext:
                     break
                 if accum_steps > 1:
                     # A ragged tail batch can't split into k equal
-                    # microbatches — crop to the largest size divisible
-                    # by k AND the local device count (so the cropped
-                    # batch still shards AND keeps micro_split's shard-
-                    # aligned fast path), dropping the leftover rows
-                    # rather than aborting the run at its last step.
-                    import math as _math
-                    div = _math.lcm(accum_steps, self.local_device_count)
+                    # microbatches — crop to the largest size that keeps
+                    # micro_split's shard-aligned fast path: the GLOBAL
+                    # batch (this LOCAL shard x num_processes, which is
+                    # what jit sees) must divide accum_steps x the mesh
+                    # DATA-axis size (the data axis can differ from
+                    # local_device_count on TP meshes and spans all
+                    # processes; this subsumes plain shardability). Per
+                    # LOCAL shard that's accum_steps x the axis's
+                    # per-process extent. Dropping leftover rows beats
+                    # aborting the run at its last step.
+                    axis = int(self.mesh.shape[self.data_axis])
+                    div = accum_steps * max(
+                        1, axis // self.num_processes)
                     lead = len(jax.tree_util.tree_leaves(batch)[0])
                     keep = (lead // div) * div
                     if keep == 0:
                         log.warning(
                             "skipping tail batch of %d rows (< "
-                            "accum_steps x local devices = %d)",
+                            "accum_steps x per-process data extent = %d)",
                             lead, div)
                         continue
                     if keep != lead:
                         log.warning(
                             "cropping tail batch %d -> %d rows for "
-                            "accum_steps=%d x %d local devices",
-                            lead, keep, accum_steps,
-                            self.local_device_count)
+                            "accum_steps=%d x per-process data extent %d",
+                            lead, keep, accum_steps, div // accum_steps)
                         batch = jax.tree_util.tree_map(
                             lambda x: x[:keep], batch)
                 # Multi-process: `data` yields LOCAL shards (shard_batch

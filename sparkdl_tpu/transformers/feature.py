@@ -20,6 +20,24 @@ from ..core.params import (HasInputCol, HasOutputCol, Param, Params,
 from ..core.pipeline import Estimator, Model, Transformer
 
 
+def _check_no_nulls(arr, stage: str, col: str) -> None:
+    """handleInvalid='error' guard. Top-level null_count misses a null
+    *element inside* a list value (the list itself is non-null), which
+    would silently become NaN through ``to_numpy(zero_copy_only=False)``
+    — so list-typed columns are also checked flattened."""
+    n = arr.null_count
+    if not n and (pa.types.is_list(arr.type)
+                  or pa.types.is_large_list(arr.type)
+                  or pa.types.is_fixed_size_list(arr.type)):
+        flat = (arr.combine_chunks() if isinstance(arr, pa.ChunkedArray)
+                else arr).flatten()
+        n = flat.null_count
+    if n:
+        raise ValueError(
+            f"{stage}: column {col!r} contains null values; clean or "
+            f"filter nulls first")
+
+
 def _toHandleInvalid(value):
     """Param converter: config errors surface at set() time on the driver
     (the core/params.py contract), not at transform time on a worker."""
@@ -61,14 +79,11 @@ class VectorAssembler(Transformer, HasOutputCol):
             pieces = []
             for c in cols:
                 arr = batch.column(c)
-                if arr.null_count:
-                    # Spark's handleInvalid='error' default: a null would
-                    # otherwise silently become NaN in the feature vector.
-                    # (No row index: this op sees streamed sub-batches, so
-                    # a local index would mislead.)
-                    raise ValueError(
-                        f"VectorAssembler: column {c!r} contains null "
-                        f"values; clean or filter nulls first")
+                # Spark's handleInvalid='error' default: a null would
+                # otherwise silently become NaN in the feature vector.
+                # (No row index: this op sees streamed sub-batches, so
+                # a local index would mislead.)
+                _check_no_nulls(arr, "VectorAssembler", c)
                 # zero-copy Arrow→ndarray (shared with the tensor
                 # transformers); float64 end-to-end — the output column
                 # type — so no silent float32 rounding; scalar columns
@@ -207,9 +222,7 @@ class StandardScaler(Estimator, HasInputCol, HasOutputCol):
             if batch.num_rows == 0:
                 continue
             arr = batch.column(col)
-            if arr.null_count:
-                raise ValueError(f"StandardScaler: column {col!r} "
-                                 f"contains null values")
+            _check_no_nulls(arr, "StandardScaler", col)
             x = columnToNdarray(arr, None, dtype=np.float64,
                                 atleast_2d=True)
             bn = len(x)
@@ -270,9 +283,7 @@ class StandardScalerModel(Model, HasInputCol, HasOutputCol):
                 return _set_column(batch, out_col, pa.array(
                     [], type=pa.list_(pa.float64())))
             arr = batch.column(col)
-            if arr.null_count:
-                raise ValueError(f"StandardScalerModel: column {col!r} "
-                                 f"contains null values")
+            _check_no_nulls(arr, "StandardScalerModel", col)
             x = columnToNdarray(arr, None, dtype=np.float64,
                                 atleast_2d=True)
             if x.shape[1:] != mean.shape:

@@ -98,10 +98,11 @@ def registerGenerationUDF(name: str, model, variables,
     LEFT-padded to one length (``models.llama.left_pad_prompts``) and runs
     as exactly TWO compiled XLA programs however many distinct prompt
     lengths appear: one masked prefill (positions count from each row's
-    first real token) + one while_loop/scan decode (EOS early exit). No
-    duplicate-row fill, no per-length recompiles. Rows are chunked to
-    ``batchRows`` so a huge column doesn't build one giant cache (chunks
-    of equal row count reuse the same programs).
+    first real token) + one while_loop/scan decode (EOS early exit) — no
+    per-length recompiles. Rows are chunked to ``batchRows`` so a huge
+    column doesn't build one giant cache; a short trailing chunk fills
+    with duplicate rows (dropped from the output) so every chunk reuses
+    the same two programs.
     """
     _UDF_REGISTRY[name] = _make_generation_apply(
         model, variables, max_new_tokens=max_new_tokens,
@@ -179,7 +180,13 @@ def _streamed_token_apply(df: DataFrame, inputCol: str, outputCol: str,
                           out_type) -> DataFrame:
     """Shared streamed data plane for token-id-column UDFs (generation,
     sequence classification) — round-3 verdict Next #5, one source of
-    truth. The column never materializes whole on the host:
+    truth. The win is CHUNKED DEVICE COMPUTE — one compiled
+    (batchRows, max_len) program signature and one batchRows-sized KV
+    cache however large the column — not host-memory residency: the
+    ``cache()`` below materializes pending-op output (the token column)
+    in full on the host, and the final ``repartition`` assembles the
+    whole output table once. Host-side the column is token ids (small);
+    device-side nothing beyond one chunk is ever live.
 
     - pending upstream ops are cached ONCE (two passes must not run a
       tokenizer twice);
@@ -189,8 +196,12 @@ def _streamed_token_apply(df: DataFrame, inputCol: str, outputCol: str,
       value every chunk must share for a single compiled signature;
     - pass 2 re-streams the chunks through ``compute(rows, max_len,
       n_fill) -> pa.Array`` (length == len(rows)); ``n_fill`` dummy
-      duplicate rows keep a trailing partial chunk on the same compiled
-      (batchRows, max_len) signature — compute appends and drops them;
+      duplicate rows keep a short chunk on the same compiled
+      (batchRows, max_len) signature — compute appends and drops them.
+      ``iterBatches`` erases partition boundaries, so only the FINAL
+      chunk can be short; a column that fits in one sub-batchRows chunk
+      is left unfilled (its single smaller signature is the only one
+      compiled, and filling would pay batchRows of compute for n rows);
     - an empty column keeps the schema contract; the output restores the
       input's partition count (chunk layout is an implementation detail).
     """
@@ -234,10 +245,13 @@ def _streamed_token_apply(df: DataFrame, inputCol: str, outputCol: str,
             tbl, numPartitions=max(1, df.numPartitions))
 
     out_parts: list[pa.RecordBatch] = []
-    for chunk_idx, batch in enumerate(df.iterBatches(batchRows)):
+    for batch in df.iterBatches(batchRows):
         rows = batch.column(inputCol).to_pylist()
         n = len(rows)
-        n_fill = batchRows - n if (n < batchRows and chunk_idx > 0) else 0
+        # fill ANY short chunk of a multi-chunk column (iterBatches: only
+        # the last can be short) so every chunk shares one signature
+        n_fill = batchRows - n if (n < batchRows
+                                   and n_rows > batchRows) else 0
         out = compute(rows, max_len, n_fill)
         assert len(out) == n, f"compute returned {len(out)} for {n} rows"
         out_parts.append(_set_column(batch, outputCol, out))

@@ -46,6 +46,24 @@ def test_vector_assembler_rejects_nulls_and_handles_fixed_size_list():
         sdl.VectorAssembler(inputCols=["a"], outputCol="f").transform(df) \
             .collect()
 
+    # a null ELEMENT inside a non-null list value must error too — the
+    # top-level null_count is 0 and conversion would silently emit NaN
+    nested = pa.array([[1.0, None], [2.0, 3.0]],
+                      type=pa.list_(pa.float64()))
+    dfn = sdl.DataFrame.fromArrow(pa.table({"v": nested}))
+    with pytest.raises(ValueError, match="contains null"):
+        sdl.VectorAssembler(inputCols=["v"], outputCol="f") \
+            .transform(dfn).collect()
+    with pytest.raises(ValueError, match="contains null"):
+        sdl.StandardScaler(inputCol="v", outputCol="s").fit(dfn)
+    # fixed_size_list hides nested nulls the same way
+    fsln = pa.FixedSizeListArray.from_arrays(
+        pa.array([1.0, None, 2.0, 3.0], pa.float64()), 2)
+    dff = sdl.DataFrame.fromArrow(pa.table({"v": fsln}))
+    with pytest.raises(ValueError, match="contains null"):
+        sdl.VectorAssembler(inputCols=["v"], outputCol="f") \
+            .transform(dff).collect()
+
     # float64 survives end-to-end (no silent float32 squeeze) and
     # large_list columns work
     exact = 16777217.0  # 2**24 + 1: not representable in float32

@@ -239,3 +239,11 @@ def test_show(capsys):
     df.show(n=1)
     out2 = capsys.readouterr().out
     assert "22" not in out2
+    # the ubiquitous Spark idiom: truncate=True means the default 20,
+    # not the bool-as-int s[:True] one-char cut; False disables
+    df.show(truncate=True)
+    out3 = capsys.readouterr().out
+    assert "a-very-long-strin..." in out3
+    df.show(truncate=False)
+    out4 = capsys.readouterr().out
+    assert "a-very-long-string-that-overflows" in out4

@@ -192,7 +192,7 @@ class TestTrainStep:
             tx=optax.sgd(0.1), apply_fn=apply_fn, data=data(),
             num_steps=3, log_every=1, accum_steps=2))
         steps = [h["step"] for h in res["history"]]
-        # 32 runs whole; 20 crops to 16 (lcm(2, 8 devices) = 8);
+        # 32 runs whole; 20 crops to 16 (accum 2 x data-axis 8 = 16);
         # 3 is skipped entirely -> two optimizer steps happened
         assert steps == [1, 2]
         assert all(np.isfinite(h["loss"]) for h in res["history"])

@@ -541,6 +541,39 @@ def test_generation_udf_streams_without_full_materialization(monkeypatch):
         assert list(r["c"]) == solo[0].tolist()
 
 
+def test_generation_udf_single_compiled_signature():
+    """Every chunk of a multi-chunk column — including the short tail —
+    runs on ONE compiled (batchRows, max_len) prefill + decode signature
+    (round-4 verdict Next #9: the tail fills with duplicate rows to
+    batchRows, so a 70-rows/batchRows-64-shaped column compiles exactly
+    one program pair, not a second tail-sized one)."""
+    import sparkdl_tpu as sdl
+    from sparkdl_tpu.models import llama as llama_mod
+    from sparkdl_tpu.models.llama import LlamaConfig, LlamaModel
+    from sparkdl_tpu.udf import registerGenerationUDF, unregisterUDF
+
+    cfg = LlamaConfig.tiny()
+    model = LlamaModel(cfg)
+    v = model.init(jax.random.PRNGKey(0), np.zeros((1, 4), np.int32))
+    rng = np.random.RandomState(8)
+    # 18 rows / batchRows=8 → chunks of 8, 8, 2(+6 fill) — same shape
+    # class as the verdict's 70/64 example, at test-sized cost
+    prompts = [rng.randint(0, cfg.vocab_size, n).tolist()
+               for n in ([3, 5, 2, 4, 6, 3, 2, 5] * 2 + [4, 3])]
+    df = sdl.DataFrame.fromPydict({"p": prompts})
+
+    llama_mod._prefill.clear_cache()
+    llama_mod._decode.clear_cache()
+    registerGenerationUDF("sig", model, v, max_new_tokens=2, batchRows=8)
+    try:
+        rows = sdl.applyUDF(df, "sig", "p", "c").collect()
+    finally:
+        unregisterUDF("sig")
+    assert len(rows) == 18
+    assert llama_mod._prefill._cache_size() == 1
+    assert llama_mod._decode._cache_size() == 1
+
+
 def test_sequence_classification_udf():
     """The config-4 serving half: ragged token-id columns stream through
     ONE compiled encoder-classifier program (right-pad + attention mask),
@@ -749,9 +782,11 @@ class TestFlashPrefill:
         assert not calls  # fell back to dense; the wrapper never ran
 
     def test_chunked_prefill_first_chunk_flag(self):
-        """A chunked multi-call prefill: chunk 2 (cache index > 0) with
-        first_chunk=False must attend the earlier cache — logits equal the
-        single-call prefill of the full prompt."""
+        """A chunked multi-call prefill: chunk 2 (cache index > 0) must
+        attend the earlier cache — logits equal the single-call prefill of
+        the full prompt. first_chunk defaults to False, so an unaware
+        chunked caller is correct by default; only cache-index-0 callers
+        opt into the square flash fast path explicitly."""
         import jax.numpy as jnp
         from sparkdl_tpu.models.llama import (LlamaModel, generate,
                                               init_cache)
@@ -765,7 +800,8 @@ class TestFlashPrefill:
             cache = init_cache(model, 2, 16)
             variables = {"params": v["params"], "cache": cache}
             out1, mut = model.apply(variables, jnp.asarray(ids[:, :8]),
-                                    decode=True, mutable=["cache"])
+                                    decode=True, first_chunk=True,
+                                    mutable=["cache"])
             variables = {"params": v["params"], "cache": mut["cache"]}
             out2, _ = model.apply(variables, jnp.asarray(ids[:, 8:]),
                                   decode=True, first_chunk=False,
