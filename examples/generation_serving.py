@@ -1,9 +1,14 @@
 """Batch text generation through the UDF registry — the registerUDF
 inference half of BASELINE config 5.
 
-Mixed-length prompts run as exactly two compiled programs (left-padded
-prefill + while_loop decode with EOS early exit), streamed from the
-DataFrame in batchRows chunks.
+Part 1 (token columns): mixed-length prompts run as exactly two compiled
+programs (left-padded prefill + while_loop decode with EOS early exit),
+streamed from the DataFrame in batchRows chunks.
+
+Part 2 (STRING columns, zero external assets): train the in-repo
+ByteBPETokenizer on a local corpus, then drive a text column through
+registerTextGenerationUDF — string → tokens → generate → string without
+downloading anything.
 
 Run: JAX_PLATFORMS=cpu python examples/generation_serving.py
 """
@@ -22,15 +27,12 @@ import numpy as np
 
 import sparkdl_tpu as sdl
 from sparkdl_tpu.models.llama import LlamaConfig, LlamaModel
-from sparkdl_tpu.udf import applyUDF, registerGenerationUDF
+from sparkdl_tpu.models.tokenizer import ByteBPETokenizer
+from sparkdl_tpu.udf import (applyUDF, registerGenerationUDF,
+                             registerTextGenerationUDF)
 
 
-def main():
-    cfg = LlamaConfig.tiny()  # random init — swap in load_pretrained(...)
-    model = LlamaModel(cfg)
-    variables = model.init(jax.random.PRNGKey(0),
-                           np.zeros((1, 4), np.int32))
-
+def token_column_serving(model, variables, cfg):
     rng = np.random.RandomState(0)
     prompts = [rng.randint(0, cfg.vocab_size, n).tolist()
                for n in (5, 2, 7, 3, 6)]
@@ -46,6 +48,42 @@ def main():
     assert all(len(c) == len(p) + 8 for p, c in
                zip(out["prompt"], out["completion"]))
     print("5 prompts, 3 lengths, ONE prefill + ONE decode program.")
+
+
+def string_column_serving(model, variables):
+    # Train the tokenizer on any local text — here, this very script.
+    # (A real deployment would train on its domain corpus and .save()
+    # the merges next to the model checkpoint.)
+    with open(os.path.abspath(__file__)) as f:
+        corpus = f.read().splitlines()
+    tok = ByteBPETokenizer.train(corpus, vocab_size=400)
+    print(f"tokenizer: {tok.vocab_size} ids "
+          f"({len(tok.merges)} learned merges)")
+
+    df = sdl.DataFrame.fromPydict({"text": [
+        "batch text generation",
+        "the DataFrame streams prompts",
+        "left-padded prefill",
+    ]})
+    registerTextGenerationUDF(
+        "continue", model, variables, encode=tok.encode, decode=tok.decode,
+        max_new_tokens=6, seed=0, batchRows=2,
+        eos_id=ByteBPETokenizer.EOS)
+    out = applyUDF(df, "continue", "text", "completion").toPandas()
+    for t, c in zip(out["text"], out["completion"]):
+        print(f"  {t!r} -> {c!r}")
+    assert all(isinstance(c, str) for c in out["completion"])
+    print("string column -> tokenize -> generate -> detokenize, "
+          "in-repo tokenizer only.")
+
+
+def main():
+    cfg = LlamaConfig.tiny()  # random init — swap in load_pretrained(...)
+    model = LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           np.zeros((1, 4), np.int32))
+    token_column_serving(model, variables, cfg)
+    string_column_serving(model, variables)
 
 
 if __name__ == "__main__":

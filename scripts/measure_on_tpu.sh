@@ -15,8 +15,10 @@ if ! timeout 120 python -c "import jax; print(jax.devices())" >&2; then
     exit 1
 fi
 
-echo "== 2/3 bench (all legs) ==" >&2
-python bench.py
+echo "== 2/3 bench (all legs, incl north-star scale) ==" >&2
+BENCH_NORTHSTAR_ROWS="${BENCH_NORTHSTAR_ROWS:-100000}" python bench.py
 
+# pytest output goes to stderr so stdout stays ONE parseable JSON record
+# (probe_loop.sh captures stdout as BENCH_TPU_MEASURED.json)
 echo "== 3/3 compiled Pallas kernel tests on the chip ==" >&2
-SPARKDL_TEST_PLATFORM=axon python -m pytest tests/test_ops.py -q
+SPARKDL_TEST_PLATFORM=axon python -m pytest tests/test_ops.py -q >&2

@@ -31,7 +31,7 @@ from .graph import (GraphFunction, IsolatedSession, TFInputGraph,
 from .ops import flash_attention
 from .image.imageIO import (createResizeImageUDF, imageSchema, readImages,
                             readImagesWithCustomFn)
-from .models import load_pretrained
+from .models import ByteBPETokenizer, load_pretrained
 from .transformers import (DeepImageFeaturizer, DeepImagePredictor,
                            KerasImageFileTransformer, KerasTransformer,
                            TFImageTransformer, TFTransformer,
@@ -55,7 +55,7 @@ __all__ = [
     "Pipeline", "PipelineModel", "MLWritable", "load",
     "imageSchema", "readImages", "readImagesWithCustomFn",
     "createResizeImageUDF",
-    "load_pretrained",
+    "load_pretrained", "ByteBPETokenizer",
     "XlaImageTransformer", "TFImageTransformer",
     "DeepImageFeaturizer", "DeepImagePredictor",
     "KerasImageFileTransformer", "XlaTransformer", "TFTransformer",

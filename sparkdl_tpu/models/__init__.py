@@ -6,6 +6,7 @@ from .pretrained import (CheckpointMismatch, import_hf_bert, import_hf_llama,
                          import_keras_inception, import_keras_resnet,
                          import_keras_vgg, import_keras_xception,
                          load_pretrained, merge_into_template, read_keras_h5)
+from .tokenizer import ByteBPETokenizer
 from .registry import (SUPPORTED_MODELS, NamedImageModel, decodePredictions,
                        get_model, load_safetensors, load_weights,
                        preprocess_caffe, preprocess_tf, preprocess_torch,
@@ -23,4 +24,5 @@ __all__ = [
     "import_keras_resnet", "import_keras_vgg", "import_keras_inception",
     "import_keras_xception",
     "read_keras_h5", "merge_into_template", "CheckpointMismatch",
+    "ByteBPETokenizer",
 ]

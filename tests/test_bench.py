@@ -59,6 +59,72 @@ def test_exhausted_budget_yields_error_record():
 
 
 @pytest.mark.slow
+def test_all_metric_legs_run_end_to_end_tiny_cpu():
+    """Every metric leg's BODY executes end-to-end at tiny config on CPU
+    (round-4 verdict Next #2): a leg regression must turn the suite red,
+    never be discovered on chip time. Asserts the one-record contract,
+    every leg's keys present, no *_error fields, an honest null
+    vs_baseline when no baseline exists (BENCH_BASELINE_PATH pointed at
+    a nonexistent temp path, so a real chip baseline in the repo never
+    leaks into this CPU run), and the EOS leg proving a MID-STREAM
+    while_loop exit (0 < steps < new)."""
+    import tempfile
+    _tmp = tempfile.mkdtemp()
+    rec = _run({"BENCH_BASELINE_PATH": os.path.join(_tmp, "none.json"),
+                "BENCH_MODEL": "ResNet18", "BENCH_IMAGE_SIZE": "64",
+                "BENCH_BATCH_PER_CHIP": "8", "BENCH_STEPS": "3",
+                "BENCH_FEAT_ROWS": "16", "BENCH_FEAT_BATCH": "8",
+                "BENCH_BERT_CONFIG": "tiny", "BENCH_BERT_BATCH": "4",
+                "BENCH_BERT_SEQ": "64", "BENCH_GEN_CONFIG": "tiny",
+                "BENCH_GEN_BATCH": "2", "BENCH_GEN_PROMPT": "16",
+                "BENCH_GEN_NEW": "8", "BENCH_FLASH_SEQS": "256",
+                "BENCH_WALL_S": "900"}, timeout=900)
+    assert rec["value"] > 0, rec
+    assert rec["vs_baseline"] is None  # no baseline file -> null, not 1.0
+    assert rec["extra"]["baseline"] == "none"
+    assert "error" not in rec
+    extra = rec["extra"]
+    errs = [k for k in extra if k.endswith("_error")]
+    assert not errs, {k: extra[k] for k in errs}
+    for key in ("mfu", "featurizer_rows_per_sec", "featurizer_breakdown",
+                "bert_tokens_s_chip", "gen_e2e_tokens_s", "flash"):
+        assert key in extra, f"leg output missing {key}: {sorted(extra)}"
+    assert "gen_eos_error" not in extra
+    # mid-stream EOS exit: the loop iterated, then stopped early
+    assert 0 < extra["gen_eos_steps"] < extra["gen_new_tokens"], extra
+    assert extra["gen_eos_steps"] == extra["gen_eos_expected_step"]
+    assert extra["gen_eos_early_exit"] is True
+
+
+@pytest.mark.slow
+def test_northstar_leg_streams_in_o_batch_memory():
+    """The north-star-scale leg (round-4 verdict Next #6) at reduced N:
+    the streamed featurize→parquet run's peak-RSS growth must stay FAR
+    below the materialized input size — the in-suite pin of the
+    O(batch)-at-scale claim (measured 36 MB vs 226 MB materialized on
+    CPU; bound set at 3x headroom)."""
+    env = dict(os.environ)
+    env.update({"BENCH_NORTHSTAR_ROWS": "1500",
+                "BENCH_NORTHSTAR_BATCH": "64",
+                "BENCH_NORTHSTAR_MODEL": "ResNet18",
+                # single device, like the real single-chip deployment:
+                # the 8-virtual-device test mesh multiplies XLA's
+                # per-device allocator overhead into the RSS reading,
+                # which is runtime noise, not data-plane residency
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1"})
+    proc = subprocess.run(
+        [sys.executable, _BENCH, "--worker", "northstar"],
+        capture_output=True, text=True, timeout=540, env=env)
+    assert proc.returncode == 0, proc.stderr[-600:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["northstar_rows"] == 1500
+    assert rec["northstar_rows_per_sec"] > 0
+    materialized = rec["northstar_input_mb_if_materialized"]
+    assert materialized > 200  # the leg is actually at a meaningful N
+    assert rec["northstar_peak_rss_delta_mb"] < min(materialized / 2, 120)
+
+
+@pytest.mark.slow
 def test_probe_worker_records_backend_identity():
     """The probe leg must report what the backend registers as — the
     artifact that settles the axon-vs-tpu platform-gate question each

@@ -40,3 +40,4 @@ def test_distributed_training_example():
 def test_generation_serving_example():
     out = _run("generation_serving.py")
     assert "ONE prefill + ONE decode program" in out
+    assert "in-repo tokenizer only" in out  # config-5 string path

@@ -1,0 +1,104 @@
+"""ByteBPETokenizer: the self-contained tokenizer that makes the
+config-5 STRING-column serving path runnable with zero external assets
+(round-4 verdict Next #5). Round-trip is guaranteed by the byte base;
+training must actually compress; save/load must reproduce encodings;
+and the registerTextGenerationUDF wiring must run string → tokens →
+generate → string end-to-end."""
+
+import jax
+import numpy as np
+import pytest
+
+from sparkdl_tpu.models.tokenizer import ByteBPETokenizer
+
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    "the lazy dog sleeps while the quick fox runs",
+    "a quick brown dog and a lazy fox",
+    "the the the quick quick lazy lazy fox dog",
+]
+
+
+def test_untrained_round_trip_any_text():
+    tok = ByteBPETokenizer()
+    for text in ["hello world", "", "  spaces  and\nnewlines\t",
+                 "unicode: héllo wörld — ≠ 🦊", "a"]:
+        assert tok.decode(tok.encode(text)) == text
+    # untrained = pure bytes + specials
+    assert tok.vocab_size == 259
+    assert tok.encode("ab") == [97, 98]
+
+
+def test_training_learns_merges_and_compresses():
+    tok = ByteBPETokenizer.train(CORPUS, vocab_size=320)
+    assert 259 < tok.vocab_size <= 320
+    text = "the quick lazy fox"
+    ids = tok.encode(text)
+    assert len(ids) < len(text.encode())  # actually compresses
+    assert tok.decode(ids) == text
+    # unseen text (even unseen bytes) still round-trips via byte fallback
+    assert tok.decode(tok.encode("zebra ≠ fox!")) == "zebra ≠ fox!"
+
+
+def test_specials_and_flags():
+    tok = ByteBPETokenizer.train(CORPUS, vocab_size=280)
+    ids = tok.encode("the fox", add_bos=True, add_eos=True)
+    assert ids[0] == ByteBPETokenizer.BOS
+    assert ids[-1] == ByteBPETokenizer.EOS
+    # specials decode to nothing — generation output with a trailing EOS
+    # detokenizes cleanly
+    assert tok.decode(ids) == "the fox"
+    assert tok.decode([ByteBPETokenizer.PAD] * 3) == ""
+
+
+def test_save_load_reproduces_encoding(tmp_path):
+    tok = ByteBPETokenizer.train(CORPUS, vocab_size=300)
+    p = str(tmp_path / "bpe.json")
+    tok.save(p)
+    tok2 = ByteBPETokenizer.load(p)
+    assert tok2.vocab_size == tok.vocab_size
+    for text in CORPUS + ["held-out the lazy zebra"]:
+        assert tok2.encode(text) == tok.encode(text)
+    with pytest.raises(ValueError, match="format"):
+        import json
+        bad = str(tmp_path / "bad.json")
+        with open(bad, "w") as f:
+            json.dump({"merges": []}, f)
+        ByteBPETokenizer.load(bad)
+
+
+def test_deterministic_training():
+    a = ByteBPETokenizer.train(CORPUS, vocab_size=300)
+    b = ByteBPETokenizer.train(CORPUS, vocab_size=300)
+    assert a.merges == b.merges
+
+
+def test_text_generation_udf_end_to_end_with_in_repo_tokenizer():
+    """BASELINE config-5 string serving with ZERO external assets: train
+    the tokenizer in-process, size the model's vocab off it, and drive a
+    string column through registerTextGenerationUDF."""
+    import sparkdl_tpu as sdl
+    from sparkdl_tpu.models.llama import LlamaConfig, LlamaModel
+    from sparkdl_tpu.udf import registerTextGenerationUDF, unregisterUDF
+
+    tok = ByteBPETokenizer.train(CORPUS, vocab_size=300)
+    cfg = LlamaConfig.tiny()  # vocab 512 covers the 300 tokenizer ids
+    assert cfg.vocab_size >= tok.vocab_size
+    model = LlamaModel(cfg)
+    v = model.init(jax.random.PRNGKey(0), np.zeros((1, 4), np.int32))
+
+    df = sdl.DataFrame.fromPydict(
+        {"prompt": ["the quick fox", "a lazy dog", "the the the"]})
+    registerTextGenerationUDF(
+        "txt", model, v, encode=tok.encode, decode=tok.decode,
+        max_new_tokens=4, batchRows=2, eos_id=ByteBPETokenizer.EOS)
+    try:
+        out = sdl.applyUDF(df, "txt", "prompt", "completion").collect()
+    finally:
+        unregisterUDF("txt")
+    assert len(out) == 3
+    for r in out:
+        assert isinstance(r["completion"], str)
+    # prompts survive untouched alongside the completion column
+    assert [r["prompt"] for r in out] == \
+        ["the quick fox", "a lazy dog", "the the the"]
