@@ -19,6 +19,7 @@
 #include <cstdint>
 #include <cstring>
 #include <thread>
+#include <type_traits>
 #include <vector>
 
 namespace {
@@ -86,6 +87,34 @@ void pack_one(const uint8_t* src, int h, int w, int c, T* dst, int out_h,
               std::vector<float>& scratch) {
   if (h == out_h && w == out_w) {
     const int64_t n = static_cast<int64_t>(h) * w;
+    if constexpr (std::is_same_v<T, uint8_t>) {
+      // u8->u8 with identity affine is a pure byte shuffle — the wire
+      // format of the uint8 feed path, where routing every sample
+      // through float+clamp+round costs ~3x. memcpy when the channel
+      // order already matches; a 3-byte swap loop for BGR->RGB.
+      if (scale == 1.0f && offset == 0.0f) {
+        bool identity = true;
+        for (int ch = 0; ch < c; ++ch) identity &= (perm[ch] == ch);
+        if (identity) {
+          std::memcpy(dst, src, static_cast<size_t>(n) * c);
+        } else if (c == 3) {
+          for (int64_t i = 0; i < n; ++i) {
+            const uint8_t* px = src + i * 3;
+            uint8_t* out = dst + i * 3;
+            out[0] = px[2];
+            out[1] = px[1];
+            out[2] = px[0];
+          }
+        } else {
+          for (int64_t i = 0; i < n; ++i) {
+            const uint8_t* px = src + i * c;
+            uint8_t* out = dst + i * c;
+            for (int ch = 0; ch < c; ++ch) out[ch] = px[perm[ch]];
+          }
+        }
+        return;
+      }
+    }
     for (int64_t i = 0; i < n; ++i) {
       const uint8_t* px = src + i * c;
       T* out = dst + i * c;

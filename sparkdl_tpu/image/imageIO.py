@@ -255,11 +255,16 @@ def imageColumnToNHWC(column: pa.Array, height: int | None = None,
         raise ValueError(f"Mixed channel counts in image column: "
                          f"{sorted(set(chans.tolist()))}")
     flip = channelOrder.upper() == "RGB" and c >= 3
-    packed = _native_pack_or_none(
-        lambda: [data[i].as_buffer() for i in range(n)], heights, widths,
-        modes, c, h, w, flip, dtype)
-    if packed is not None:
-        return packed
+    if _pack_gate(modes, dtype):
+        from .. import native
+        packed = _arrow_ptr_pack_or_none(data, heights, widths, c, h, w,
+                                         flip, dtype)
+        if packed is None:  # exotic layout — per-row buffer path
+            packed = native.pack_images(
+                [data[i].as_buffer() for i in range(n)], heights, widths,
+                c, h, w, flip_bgr=flip, dtype=dtype)
+        if packed is not None:
+            return packed
     out = np.empty((n, h, w, c), dtype=dtype)
     for i in range(n):
         src_dtype = ocvTypeByMode(int(modes[i])).dtype
@@ -275,27 +280,70 @@ def imageColumnToNHWC(column: pa.Array, height: int | None = None,
     return out
 
 
-def _native_pack_or_none(buffers_fn, heights, widths, modes, c, h, w, flip,
-                         dtype):
-    """Shared hot-path gate: all-uint8 rows + float32 out → the native
-    packer (C++: threaded resize + channel flip + u8→f32 in one pass; the
-    TensorFrames-JNI-equivalent role, SURVEY.md §2.3). None ⇒ caller takes
-    the pure-python path. ``buffers_fn`` defers per-row buffer
-    materialization until every cheap gate has passed. NB: the fallback
+def _pack_gate(modes, dtype) -> bool:
+    """THE native-packer eligibility gate (one copy: both the struct-list
+    and Arrow column paths consult it): supported output dtype, not
+    disabled by env, all rows uint8-moded. NB: the pure-python fallback
     resizes through uint8 (PIL), so resized values can differ from the
-    native float path by <1 level — native.py logs once when the library is
-    unavailable.
-    """
+    native float path by <1 level — native.py logs once when the library
+    is unavailable."""
     if (np.dtype(dtype) not in (np.dtype(np.float32), np.dtype(np.uint8))
             or os.environ.get("SPARKDL_TPU_NATIVE", "1") == "0"
             or not all(ocvTypeByMode(int(m)).dtype == "uint8"
                        for m in modes)):
+        return False
+    from .. import native
+    return native.available()
+
+
+def _native_pack_or_none(buffers_fn, heights, widths, modes, c, h, w, flip,
+                         dtype):
+    """Struct-list entry to the native packer (C++: threaded resize +
+    channel flip + u8→f32/u8 in one pass; the TensorFrames-JNI-equivalent
+    role, SURVEY.md §2.3). None ⇒ caller takes the pure-python path.
+    ``buffers_fn`` defers per-row buffer materialization until the gate
+    has passed."""
+    if not _pack_gate(modes, dtype):
         return None
     from .. import native
-    if not native.available():
-        return None
     return native.pack_images(buffers_fn(), heights, widths, c, h, w,
                               flip_bgr=flip, dtype=dtype)
+
+
+def _arrow_ptr_pack_or_none(data: pa.Array, heights, widths, c, h, w,
+                            flip, dtype):
+    """Zero-copy Arrow fast path: source addresses come straight from the
+    binary child's values buffer + offsets — no per-row buffer objects
+    and no per-row ctypes casts, which cost ~30% of wall time on the
+    per-row path at 299x299. Caller has already passed ``_pack_gate``;
+    this adds only LAYOUT checks, returning None for layouts it doesn't
+    cover (nulls, non-binary storage); size mismatches raise, matching
+    pack_images' contract."""
+    from .. import native
+
+    if pa.types.is_binary(data.type):
+        off_dtype = np.dtype(np.int32)
+    elif pa.types.is_large_binary(data.type):
+        off_dtype = np.dtype(np.int64)
+    else:
+        return None
+    if data.null_count:
+        return None
+    bufs = data.buffers()
+    offsets = np.frombuffer(
+        bufs[1], dtype=off_dtype, count=len(data) + 1,
+        offset=data.offset * off_dtype.itemsize).astype(np.int64)
+    lens = np.diff(offsets)
+    expected = (np.asarray(heights, np.int64)
+                * np.asarray(widths, np.int64) * c)
+    if not (lens == expected).all():
+        i = int(np.argmax(lens != expected))
+        raise ValueError(
+            f"Image {i}: buffer has {lens[i]} bytes, expected "
+            f"{heights[i]}x{widths[i]}x{c}")
+    ptrs = np.uint64(bufs[2].address) + offsets[:-1].astype(np.uint64)
+    return native.pack_images_ptrs(ptrs, heights, widths, c, h, w,
+                                   flip_bgr=flip, dtype=dtype)
 
 
 def nhwcToStructs(batch: np.ndarray, origins: Sequence[str] | None = None,

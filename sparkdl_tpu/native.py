@@ -170,16 +170,60 @@ def pack_images(buffers: Sequence, heights: Sequence[int],
             raise ValueError(
                 f"Image {i}: buffer has {a.size} bytes, expected "
                 f"{heights[i]}x{widths[i]}x{channels}")
-    ptrs = (ctypes.c_void_p * n)(
-        *[a.ctypes.data_as(ctypes.c_void_p) for a in arrays])
-    hs = np.asarray(heights, dtype=np.int32)
-    ws = np.asarray(widths, dtype=np.int32)
-    if dtype == np.uint8:
+    ptrs = np.fromiter((a.ctypes.data for a in arrays), dtype=np.uint64,
+                       count=n)
+    # `arrays` stays alive past the native call — the addresses in `ptrs`
+    # borrow its buffers
+    result = _dispatch_pack(lib, ptrs, heights, widths, channels, out,
+                            out_h, out_w, flip_bgr, scale, offset,
+                            n_threads)
+    del arrays
+    return result
+
+
+def pack_images_ptrs(ptrs: np.ndarray, heights: Sequence[int],
+                     widths: Sequence[int], channels: int, out_h: int,
+                     out_w: int, flip_bgr: bool = True, scale: float = 1.0,
+                     offset: float = 0.0, n_threads: int = 0,
+                     dtype=np.float32):
+    """Zero-copy twin of :func:`pack_images`: ``ptrs`` is a uint64 array
+    of source ADDRESSES (e.g. an Arrow binary values-buffer base +
+    offsets), passed to C as the ``const uint8_t**`` directly — no
+    per-row buffer objects or ctypes casts on the hot path. The caller
+    owns both the address validity and the per-row size check (the
+    addresses carry no length). Returns None when the native library is
+    unavailable (the caller holds the real buffers and picks its own
+    fallback)."""
+    dtype = np.dtype(dtype)
+    if dtype not in (np.dtype(np.float32), np.dtype(np.uint8)):
+        raise TypeError(f"pack_images_ptrs output dtype must be float32 "
+                        f"or uint8, got {dtype}")
+    lib = _load()
+    if lib is None:
+        return None
+    out = np.empty((len(ptrs), out_h, out_w, channels), dtype=dtype)
+    return _dispatch_pack(lib, ptrs, heights, widths, channels, out,
+                          out_h, out_w, flip_bgr, scale, offset, n_threads)
+
+
+def _dispatch_pack(lib, ptrs, heights, widths, channels, out, out_h, out_w,
+                   flip_bgr, scale, offset, n_threads) -> np.ndarray:
+    """One marshalling point for the sdl_pack_images* C ABI — both the
+    buffer-list and address-array entries go through here, so ABI changes
+    can't drift between them. ``out.dtype`` selects the u8/f32 entry."""
+    n = len(ptrs)
+    if n == 0:
+        return out
+    ptrs = np.ascontiguousarray(ptrs, dtype=np.uint64)
+    hs = np.ascontiguousarray(heights, dtype=np.int32)
+    ws = np.ascontiguousarray(widths, dtype=np.int32)
+    if out.dtype == np.uint8:
         entry, ctype = lib.sdl_pack_images_u8, ctypes.c_uint8
     else:
         entry, ctype = lib.sdl_pack_images, ctypes.c_float
     rc = entry(
-        ptrs, hs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ptrs.ctypes.data_as(ctypes.POINTER(ctypes.c_void_p)),
+        hs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         ws.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         n, channels, out.ctypes.data_as(ctypes.POINTER(ctype)),
         out_h, out_w, int(flip_bgr), float(scale), float(offset), n_threads)

@@ -244,3 +244,35 @@ def test_image_column_to_nhwc_matches_structs_path(tmp_path):
     slow = imageIO.structsToNHWC(col.to_pylist(), 8, 6)
     np.testing.assert_array_equal(fast, slow)
     assert fast.shape == (4, 8, 6, 3)
+
+
+def test_zero_copy_arrow_pack_path(monkeypatch):
+    """The Arrow-pointer fast path (addresses straight from the binary
+    values buffer + offsets): equals the pure-python path, honors column
+    slices (nonzero Arrow offset), and still raises on a row whose byte
+    length contradicts its declared shape."""
+    import pyarrow as pa
+
+    from sparkdl_tpu import native
+
+    if not native.available():
+        pytest.skip("native packer unavailable")
+    structs = [imageIO.imageArrayToStruct(rand_img(9, 7, 3, seed=i),
+                                          origin=f"s{i}")
+               for i in range(6)]
+    col = pa.array(structs, type=imageIO.imageSchema)
+    fast = imageIO.imageColumnToNHWC(col, 9, 7, dtype=np.uint8)
+    monkeypatch.setenv("SPARKDL_TPU_NATIVE", "0")
+    ref = imageIO.imageColumnToNHWC(col, 9, 7, dtype=np.uint8)
+    monkeypatch.delenv("SPARKDL_TPU_NATIVE")
+    np.testing.assert_array_equal(fast, ref)
+
+    sliced = imageIO.imageColumnToNHWC(col.slice(2, 3), 9, 7,
+                                       dtype=np.uint8)
+    np.testing.assert_array_equal(sliced, ref[2:5])
+
+    bad = [dict(s) for s in structs]
+    bad[1]["data"] = bad[1]["data"][:-1]  # truncated payload
+    bad_col = pa.array(bad, type=imageIO.imageSchema)
+    with pytest.raises(ValueError, match="buffer has"):
+        imageIO.imageColumnToNHWC(bad_col, 9, 7, dtype=np.uint8)
