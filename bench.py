@@ -416,6 +416,21 @@ def _worker_northstar() -> dict:
     # Compile + param init outside the timed / RSS-delta window.
     feat.transform(_synthetic_image_df(batch, batch, h, w)).collect()
 
+    # Optional jax profiler capture (chip evidence: host-vs-device time
+    # split; measure_on_tpu.sh sets this on TPU). Profiles a SHORT
+    # bounded warm slice BEFORE the measured run — trace buffers grow on
+    # the host and stop_trace flushes for seconds, which would pollute
+    # the very rows/s and peak-RSS numbers the leg exists to prove.
+    profile_dir = os.environ.get("BENCH_PROFILE_DIR")
+    if profile_dir:
+        import jax
+        prof_rows = min(rows, 4 * batch)
+        jax.profiler.start_trace(profile_dir)
+        try:
+            feat.transform(
+                _synthetic_image_df(prof_rows, batch, h, w)).collect()
+        finally:
+            jax.profiler.stop_trace()
     rss0_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
     t0 = time.perf_counter()
     n_out = 0
@@ -916,6 +931,22 @@ def main():
 
     extra["budget"] = {"wall_s": budget.wall_s,
                        "spent_s": round(budget.spent(), 1)}
+    # Round-long liveness evidence: summarize scripts/probe_loop.sh's log
+    # so the record itself shows how often the backend was probed and
+    # whether any window opened (round-4 verdict Next #1).
+    try:
+        probe_path = os.path.join(_HERE, "PROBE_LOG")
+        if os.path.exists(probe_path):
+            lines = [ln.split() for ln in open(probe_path)
+                     if ln.strip() and not ln.startswith("#")]
+            ups = [ln for ln in lines if len(ln) > 1 and ln[1] == "up"]
+            downs = [ln for ln in lines if len(ln) > 1 and ln[1] == "down"]
+            extra["probe_log"] = {
+                "attempts": len(ups) + len(downs), "ups": len(ups),
+                "first": lines[0][0] if lines else None,
+                "last": lines[-1][0] if lines else None}
+    except Exception:
+        pass
     try:  # map the numbers to the code that produced them
         extra["git_rev"] = subprocess.run(
             ["git", "rev-parse", "--short", "HEAD"], capture_output=True,

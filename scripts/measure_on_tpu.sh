@@ -15,8 +15,9 @@ if ! timeout 120 python -c "import jax; print(jax.devices())" >&2; then
     exit 1
 fi
 
-echo "== 2/3 bench (all legs, incl north-star scale) ==" >&2
-BENCH_NORTHSTAR_ROWS="${BENCH_NORTHSTAR_ROWS:-100000}" python bench.py
+echo "== 2/3 bench (all legs, incl north-star scale + profile) ==" >&2
+BENCH_NORTHSTAR_ROWS="${BENCH_NORTHSTAR_ROWS:-100000}" \
+BENCH_PROFILE_DIR="${BENCH_PROFILE_DIR:-bench_profile}" python bench.py
 
 # pytest output goes to stderr so stdout stays ONE parseable JSON record
 # (probe_loop.sh captures stdout as BENCH_TPU_MEASURED.json)

@@ -37,6 +37,11 @@ def test_distributed_training_example():
     assert "-device DP: loss" in out
 
 
+def test_long_context_serving_example():
+    out = _run("long_context_serving.py")
+    assert "bit-identical" in out
+
+
 def test_generation_serving_example():
     out = _run("generation_serving.py")
     assert "ONE prefill + ONE decode program" in out

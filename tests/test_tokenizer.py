@@ -73,6 +73,24 @@ def test_deterministic_training():
     assert a.merges == b.merges
 
 
+def test_fuzz_round_trip_random_unicode():
+    """Byte-base invariant under fuzz: ANY string round-trips through a
+    TRAINED tokenizer — ascii, multi-byte code points, random unicode,
+    whitespace runs, control chars."""
+    import random
+
+    tok = ByteBPETokenizer.train(CORPUS, vocab_size=320)
+    rnd = random.Random(0)
+    pool = (
+        [chr(c) for c in range(32, 127)]
+        + list("äöüßéè日本語中文한국어🦊🎉∑≠  ")
+        + list("\t\n\r ") * 5
+    )
+    for _ in range(300):
+        s = "".join(rnd.choice(pool) for _ in range(rnd.randint(0, 60)))
+        assert tok.decode(tok.encode(s)) == s
+
+
 def test_text_generation_udf_end_to_end_with_in_repo_tokenizer():
     """BASELINE config-5 string serving with ZERO external assets: train
     the tokenizer in-process, size the model's vocab off it, and drive a
