@@ -416,21 +416,6 @@ def _worker_northstar() -> dict:
     # Compile + param init outside the timed / RSS-delta window.
     feat.transform(_synthetic_image_df(batch, batch, h, w)).collect()
 
-    # Optional jax profiler capture (chip evidence: host-vs-device time
-    # split; measure_on_tpu.sh sets this on TPU). Profiles a SHORT
-    # bounded warm slice BEFORE the measured run — trace buffers grow on
-    # the host and stop_trace flushes for seconds, which would pollute
-    # the very rows/s and peak-RSS numbers the leg exists to prove.
-    profile_dir = os.environ.get("BENCH_PROFILE_DIR")
-    if profile_dir:
-        import jax
-        prof_rows = min(rows, 4 * batch)
-        jax.profiler.start_trace(profile_dir)
-        try:
-            feat.transform(
-                _synthetic_image_df(prof_rows, batch, h, w)).collect()
-        finally:
-            jax.profiler.stop_trace()
     rss0_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
     t0 = time.perf_counter()
     n_out = 0
@@ -451,6 +436,21 @@ def _worker_northstar() -> dict:
     dt = time.perf_counter() - t0
     rss1_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
     assert n_out == rows, f"sink got {n_out} of {rows} rows"
+    # Optional jax profiler capture (chip evidence: host-vs-device time
+    # split; measure_on_tpu.sh sets this on TPU). A SHORT bounded slice
+    # AFTER both timing and RSS reads: trace buffers grow host RSS and
+    # stop_trace flushes for seconds, and ru_maxrss is a monotone
+    # high-water mark — profiling first would mask the measured run's
+    # true delta (an always-pass O(batch) "proof").
+    profile_dir = os.environ.get("BENCH_PROFILE_DIR")
+    if profile_dir:
+        import jax
+        jax.profiler.start_trace(profile_dir)
+        try:
+            feat.transform(_synthetic_image_df(
+                min(rows, 4 * batch), batch, h, w)).collect()
+        finally:
+            jax.profiler.stop_trace()
     return {"northstar_rows": rows,
             "northstar_rows_per_sec": rows / dt,
             "northstar_wall_s": dt,
@@ -596,6 +596,30 @@ def _worker_flash() -> dict:
         assert err < 2e-3, f"flash/dense mismatch at S={s}: {err}"
         out[f"s{s}"] = {"max_abs_err": err, "flash_ms": t_f * 1e3,
                         "dense_ms": t_d * 1e3, "speedup": t_d / t_f}
+        # Block-size sweep (BENCH_FLASH_BLOCKS="128,256,512"): the
+        # on-chip tuning pass — kernels re-timed per (block_q=block_k=B)
+        # and the best recorded, so a chip window directly yields the
+        # SPARKDL_FLASH_BLOCK_Q/_K setting to deploy.
+        blocks_env = os.environ.get("BENCH_FLASH_BLOCKS")
+        if blocks_env:
+            sweep = {}
+            for blk in (int(x) for x in blocks_env.split(",")):
+                if blk == 128:  # the default config, timed above as t_f
+                    sweep["128"] = t_f * 1e3
+                    continue
+                fn = jax.jit(lambda a, b, c, _blk=blk: flash_attention(
+                    a, b, c, causal=True, block_q=_blk, block_k=_blk,
+                    interpret=not compiled))
+                try:
+                    _, t_b = timed(fn, q, k, v)
+                    sweep[str(blk)] = t_b * 1e3
+                except Exception as e:
+                    sweep[str(blk)] = f"{type(e).__name__}"[:60]
+            timings = {int(kk): vv for kk, vv in sweep.items()
+                       if isinstance(vv, float)}
+            out[f"s{s}"]["block_sweep_ms"] = sweep
+            if timings:
+                out[f"s{s}"]["best_block"] = min(timings, key=timings.get)
     return out
 
 

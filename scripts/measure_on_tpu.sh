@@ -17,7 +17,8 @@ fi
 
 echo "== 2/3 bench (all legs, incl north-star scale + profile) ==" >&2
 BENCH_NORTHSTAR_ROWS="${BENCH_NORTHSTAR_ROWS:-100000}" \
-BENCH_PROFILE_DIR="${BENCH_PROFILE_DIR:-bench_profile}" python bench.py
+BENCH_PROFILE_DIR="${BENCH_PROFILE_DIR:-bench_profile}" \
+BENCH_FLASH_BLOCKS="${BENCH_FLASH_BLOCKS:-128,256,512}" python bench.py
 
 # pytest output goes to stderr so stdout stays ONE parseable JSON record
 # (probe_loop.sh captures stdout as BENCH_TPU_MEASURED.json)

@@ -241,7 +241,7 @@ _flash_core.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, causal: bool = False, *, kv_mask=None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: int | None = None, block_k: int | None = None,
                     interpret: bool | None = None):
     """Pallas flash attention. q/k/v: ``[B, H, S, D]`` → ``[B, H, S, D]``.
 
@@ -251,7 +251,16 @@ def flash_attention(q, k, v, causal: bool = False, *, kv_mask=None,
     elsewhere (CPU tests). Same (q, k, v, causal=...) signature as
     ``parallel.dense_attention``, so it drops into ``LlamaModel(attn_fn=…)``
     and ``BertEncoder(attn_fn=…)``.
+
+    ``block_q``/``block_k`` default from ``SPARKDL_FLASH_BLOCK_Q``/``_K``
+    (else 128) — an on-chip tuning lever that needs no code change; the
+    bench's flash leg sweeps it via ``BENCH_FLASH_BLOCKS``.
     """
+    import os
+    if block_q is None:
+        block_q = int(os.environ.get("SPARKDL_FLASH_BLOCK_Q", "128"))
+    if block_k is None:
+        block_k = int(os.environ.get("SPARKDL_FLASH_BLOCK_K", "128"))
     b, _, s, _ = q.shape
     if kv_mask is None:
         kv_mask = jnp.ones((b, s), jnp.float32)
