@@ -29,7 +29,8 @@ from .graph import (GraphFunction, IsolatedSession, TFInputGraph,
                     XlaInputGraph, buildFlattener, buildSpImageConverter,
                     makeGraphUDF)
 from .ops import flash_attention
-from .image.imageIO import (createResizeImageUDF, imageSchema, readImages,
+from .image.imageIO import (createResizeImageUDF, imageSchema,
+                            nhwcToImageColumn, readImages,
                             readImagesWithCustomFn)
 from .models import ByteBPETokenizer, load_pretrained
 from .transformers import (DeepImageFeaturizer, DeepImagePredictor,
@@ -54,7 +55,7 @@ __all__ = [
     "Transformer", "Estimator", "Model", "Evaluator",
     "Pipeline", "PipelineModel", "MLWritable", "load",
     "imageSchema", "readImages", "readImagesWithCustomFn",
-    "createResizeImageUDF",
+    "createResizeImageUDF", "nhwcToImageColumn",
     "load_pretrained", "ByteBPETokenizer",
     "XlaImageTransformer", "TFImageTransformer",
     "DeepImageFeaturizer", "DeepImagePredictor",

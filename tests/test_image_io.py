@@ -276,3 +276,35 @@ def test_zero_copy_arrow_pack_path(monkeypatch):
     bad_col = pa.array(bad, type=imageIO.imageSchema)
     with pytest.raises(ValueError, match="buffer has"):
         imageIO.imageColumnToNHWC(bad_col, 9, 7, dtype=np.uint8)
+
+
+def test_nhwc_to_image_column_vectorized():
+    """nhwcToImageColumn (vectorized write side) produces a column
+    identical to the per-row nhwcToStructs path, and round-trips through
+    imageColumnToNHWC."""
+    import pyarrow as pa
+
+    batch = np.stack([rand_img(6, 5, 3, seed=i) for i in range(4)])
+    origins = [f"o{i}" for i in range(4)]
+    fast = imageIO.nhwcToImageColumn(batch, origins=origins)
+    slow = pa.array(imageIO.nhwcToStructs(batch, origins=origins),
+                    type=imageIO.imageSchema)
+    assert fast.equals(slow)
+    back = imageIO.imageColumnToNHWC(fast, 6, 5, dtype=np.uint8)
+    np.testing.assert_array_equal(back, batch)
+    with pytest.raises(ValueError, match="origins"):
+        imageIO.nhwcToImageColumn(batch, origins=["x"])
+    with pytest.raises(ValueError, match="NHWC"):
+        imageIO.nhwcToImageColumn(batch[0])
+
+
+def test_nhwc_to_image_column_does_not_alias_caller_buffer():
+    """Default copy=True: mutating the input batch after conversion must
+    not change the column (the no-swap path would otherwise zero-copy
+    alias the caller's buffer)."""
+    batch = np.stack([rand_img(4, 4, 3, seed=i) for i in range(2)])
+    col = imageIO.nhwcToImageColumn(batch, channelOrder="BGR")
+    before = imageIO.imageColumnToNHWC(col, 4, 4, dtype=np.uint8).copy()
+    batch[:] = 0
+    after = imageIO.imageColumnToNHWC(col, 4, 4, dtype=np.uint8)
+    np.testing.assert_array_equal(after, before)
