@@ -61,6 +61,14 @@ import time
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 
+# Persistent XLA compilation cache, shared by every worker SUBPROCESS (and
+# by bench reruns): each leg pays the slow remote axon compile only once
+# per program signature, ever. Round-5 on-chip finding: without it the
+# ResNet-50 train leg's first compile alone blew the 480s leg timeout
+# twice and exhausted the whole 1200s budget. Env wins over the default.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", os.path.join(_HERE, ".jax_cache"))
+
 
 def _env_flag(name: str) -> bool:
     """'1'/'true'/'yes' → True; ''/'0'/'false'/'no'/unset → False (a bare

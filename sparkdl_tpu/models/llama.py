@@ -153,12 +153,14 @@ class LlamaAttention(nn.Module):
 
         rep = c.num_heads // c.num_kv_heads  # GQA tiling factor (static)
 
+        from ..ops.flash_attention import resolve_attn_fn
+        resolved_attn = resolve_attn_fn(self.attn_fn)
+
         def prefill_attn_fn(need_mask: bool):
             """The attention to run at prefill: the resolved attn_fn when
             it can express the left-pad mask contract (flash can; ring/
             Ulysses cannot — they fall back to the dense cache path)."""
-            from ..ops.flash_attention import resolve_attn_fn
-            fn = resolve_attn_fn(self.attn_fn)
+            fn = resolved_attn
             if fn is None or not need_mask:
                 return fn
             import inspect
@@ -186,8 +188,12 @@ class LlamaAttention(nn.Module):
             # O(S·max_len) score matrix (flash is the TPU default), and a
             # ring/Ulysses attn_fn shards the prefill's S^2 compute over the
             # sp mesh axis (sequence-parallel serving; unpadded prompts).
-            # Per-token DECODE steps (S == 1) always use dense cache
-            # attention; a cache-aware flash decode kernel is future work.
+            # Per-token DECODE steps (S == 1) pair with the flash prefill:
+            # when the resolved attn_fn is the flash kernel, the step runs
+            # through ops.flash_decode — HBM traffic O(cur), not
+            # O(max_len), dead cache blocks are never fetched. Any other
+            # attn_fn (dense, ring/Ulysses — sequence-sharding doesn't
+            # apply to a replicated cache) keeps the dense cache path.
             k_cache = self.variable("cache", "k", jnp.zeros,
                                     (B, c.num_kv_heads, S, hd), d)
             v_cache = self.variable("cache", "v", jnp.zeros,
@@ -250,6 +256,14 @@ class LlamaAttention(nn.Module):
                     except (TypeError, ValueError) as e:
                         _warn_prefill_fallback(flash, e)
                         o = None
+                if o is None and S == 1:
+                    from ..ops import flash_decode as fd
+                    dec = fd.decode_fn_for(resolved_attn)
+                    if dec is not None and fd.supports(k_all.shape[2]):
+                        # slots < cur+1 are live (the step's own token
+                        # attends to itself — the dense path's col <= row
+                        # with row == cur); left-pad slots masked per row.
+                        o = dec(q, k_all, v_all, cur + 1, pad_lens)
                 if o is None:
                     # grouped-query attention against the UNtiled cache:
                     # fold the GQA tiling into the einsum group axis instead
@@ -278,10 +292,8 @@ class LlamaAttention(nn.Module):
             if rep != 1:
                 k = jnp.repeat(k, rep, axis=1)
                 v = jnp.repeat(v, rep, axis=1)
-            from ..ops.flash_attention import resolve_attn_fn
-            attn_fn = resolve_attn_fn(self.attn_fn)
-            if attn_fn is not None:
-                o = attn_fn(q, k, v, causal=True)
+            if resolved_attn is not None:
+                o = resolved_attn(q, k, v, causal=True)
             else:
                 s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(hd)
                 mask = jnp.tril(jnp.ones((S, S), bool))
@@ -551,11 +563,12 @@ def generate(model: LlamaModel, variables, prompt_ids, max_new_tokens: int,
         # Host-side, once — not inside the traced apply (fires per trace).
         import logging
         logging.getLogger(__name__).warning(
-            "LlamaModel.attn_fn applies to the PREFILL pass only during "
+            "LlamaModel.attn_fn applies to the PREFILL pass during "
             "generation (flash/ring/Ulysses; left-padded prefill "
             "additionally needs kv_mask support, which only flash has); "
-            "per-token decode uses dense cache attention (a cache-aware "
-            "flash decode kernel is future work)")
+            "per-token decode runs the cache-aware flash decode kernel "
+            "when attn_fn is the flash kernel (ops.flash_decode), and "
+            "dense cache attention for every other attn_fn")
         _warned_attn_fn_ignored = True
     if not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p} — 0 would "
@@ -574,6 +587,16 @@ def generate(model: LlamaModel, variables, prompt_ids, max_new_tokens: int,
     if max_len < lp + max_new_tokens:
         raise ValueError(f"pad_to={pad_to} < prompt+new ="
                          f" {lp + max_new_tokens}")
+    from ..ops import flash_decode as _fd
+    from ..ops.flash_attention import resolve_attn_fn as _resolve_attn
+    if (_fd.decode_fn_for(_resolve_attn(model.attn_fn)) is not None
+            and not _fd.supports(max_len)):
+        # Round the cache up to the decode kernel's KV-block multiple so
+        # the flash decode path actually engages for default cache sizes
+        # (supports() needs 128-slot tiles); a few spare KV slots cost
+        # far less than every step reading the cache dense. An explicit
+        # pad_to that is already a multiple is left untouched.
+        max_len = ((max_len + _fd._LANES - 1) // _fd._LANES) * _fd._LANES
     params = variables["params"] if "params" in variables else variables
     if rng is None:
         rng = jax.random.PRNGKey(0)

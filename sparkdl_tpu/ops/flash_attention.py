@@ -78,7 +78,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, acc_ref,
         col_ids = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         mask = col_ids < seq_len
-        mask = mask & (mask_ref[0].astype(jnp.float32)[None, :] > 0)
+        mask = mask & (mask_ref[0, 0].astype(jnp.float32) > 0)
         if causal:
             row_ids = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -103,9 +103,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, acc_ref,
         l = l_ref[:, 0]
         safe_l = jnp.where(l > 0, l, 1.0)  # fully-masked rows (padding)
         o_ref[0] = (acc_ref[:] / safe_l[:, None]).astype(o_ref.dtype)
-        # lse is blocked (1, BQ) per q-tile: a full-block store, no dynamic
-        # lane-dim slicing (Mosaic-safe for any block_q).
-        lse_ref[0, :] = m + jnp.log(safe_l)
+        # lse rides in the PRE-BLOCKED 4-D layout (B·H, Sq tiles, 1, BQ):
+        # its (1, 1, 1, BQ) block's trailing dims (1, BQ) EQUAL the array
+        # dims, which satisfies Mosaic's block rule (sublane ∈ 8ℤ ∪
+        # {array dim}, lane ∈ 128ℤ ∪ {array dim}) for ANY BQ, and the
+        # in-kernel store stays a plain 2-D (1, BQ) lane-oriented write —
+        # no 1-D sublane vectors, no transpose. The real chip rejects the
+        # flat layouts ((1, BQ) block over (B·H, S): sublane 1 ∤ 8 ≠ B·H;
+        # (…, 1, BQ) block over (B·H, 1, S): BQ < 128 ∤ 128) — a round-5
+        # on-chip finding the interpreter cannot reproduce.
+        lse_ref[0, 0] = (m + jnp.log(safe_l))[None, :]
 
 
 def _fwd(q, k, v, kv_mask, causal: bool, block_q: int, block_k: int,
@@ -125,7 +132,11 @@ def _fwd(q, k, v, kv_mask, causal: bool, block_q: int, block_k: int,
     q3 = q.reshape(b * h, s, d)
     k3 = k.reshape(b * h, s, d)
     v3 = v.reshape(b * h, s, d)
-    # [B, S] 0/1 kv mask → (B*H, S) f32 stream (tiny next to K/V tiles)
+    # [B, S] 0/1 kv mask → pre-blocked 4-D (B*H, S/BK, 1, BK) f32 stream
+    # (tiny next to K/V tiles): each (1, 1, 1, BK) block's trailing dims
+    # (1, BK) EQUAL the array dims, so the layout is Mosaic-legal for
+    # ANY BK and the kernel reads a plain 2-D (1, BK) lane-oriented tile
+    # (see the lse comment in _fwd_kernel for the rejected flat layouts).
     m2 = jnp.broadcast_to(kv_mask.astype(jnp.float32)[:, None, :],
                           (b, h, s)).reshape(b * h, s)
     if s_pad != s:
@@ -134,6 +145,7 @@ def _fwd(q, k, v, kv_mask, causal: bool, block_q: int, block_k: int,
         k3 = jnp.pad(k3, padding)
         v3 = jnp.pad(v3, padding)
         m2 = jnp.pad(m2, ((0, 0), (0, s_pad - s)))
+    m4 = m2.reshape(b * h, s_pad // bk, 1, bk)
     from jax.experimental.pallas import tpu as pltpu
 
     grid = (b * h, s_pad // bq, s_pad // bk)
@@ -145,15 +157,15 @@ def _fwd(q, k, v, kv_mask, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
-            pl.BlockSpec((1, bk), lambda bh, i, j: (bh, j)),
+            pl.BlockSpec((1, 1, 1, bk), lambda bh, i, j: (bh, j, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, bq), lambda bh, i, j: (bh, i)),
+            pl.BlockSpec((1, 1, 1, bq), lambda bh, i, j: (bh, i, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, s_pad, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, s_pad), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, s_pad // bq, 1, bq), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),        # acc
@@ -161,9 +173,9 @@ def _fwd(q, k, v, kv_mask, causal: bool, block_q: int, block_k: int,
             pltpu.VMEM((bq, _LANES), jnp.float32),   # normalizer l
         ],
         interpret=interpret,
-    )(q3, k3, v3, m2)
+    )(q3, k3, v3, m4)
     return (o3[:, :s].reshape(b, h, s, d),
-            lse2[:, :s].reshape(b, h, s))
+            lse2.reshape(b * h, s_pad)[:, :s].reshape(b, h, s))
 
 
 def _bwd_one_head(q, k, v, o, lse, do, kv_mask, causal: bool, block_k: int,

@@ -1,0 +1,201 @@
+"""Cache-aware flash DECODE attention as a Pallas TPU kernel.
+
+The per-token serving hot op. Prefill runs through the flash kernel in
+``ops.flash_attention``; this kernel covers the other half of generation:
+one query token per row attending to the KV **cache** at a dynamic fill
+index. The reference framework had no serving path at all (2017-era image
+scoring — SURVEY.md §2.4/§3.3); this exists for ``models.llama.generate``
+and ``udf.registerGenerationUDF``, whose decode loop is the long-context
+serving bottleneck.
+
+Why a kernel at all: decode is bandwidth-bound — the cost of a step is
+reading the KV cache from HBM. The in-model dense path necessarily reads
+the **whole** ``max_len`` cache every step (static shapes under jit), even
+when only ``cur`` slots are live. This kernel makes the dead region cost
+~nothing with a *static* grid:
+
+- ``cur`` (the cache fill index, a traced scalar) and per-row left-pad
+  lengths ride in as **scalar-prefetch** operands
+  (``pltpu.PrefetchScalarGridSpec``), so the KV BlockSpec index maps can
+  depend on them before the body runs;
+- the KV index map clamps every dead block (``start >= cur``) to the last
+  LIVE block index — Pallas skips the DMA when consecutive grid steps map
+  to the same block, so dead blocks are neither fetched from HBM nor
+  computed (``pl.when`` gates the body). Bytes moved per step scale with
+  ``cur``, not ``max_len``: early in a long-context decode this is a
+  many-fold HBM-traffic cut, and it is exactly the trick a static-shape
+  XLA graph cannot express;
+- GQA runs against the **untiled** cache: queries reshape to
+  ``(kv_heads, group)`` and each kv head's K/V block is read once for all
+  ``group`` queries — no ``jnp.repeat`` of the cache (the dense path's
+  einsum grouping shares this property; the kernel keeps it);
+- the online-softmax accumulator/stats persist in VMEM scratch across KV
+  steps, exactly as in the prefill kernel.
+
+``interpret=True`` (auto on non-TPU) runs the same kernel through the
+Pallas interpreter — CPU tests prove numerical equivalence against the
+dense cache path; generation-level tests prove token equality end to end.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .flash_attention import NEG_INF, _LANES, _resolve
+
+# Minimum sublane count for the query block: the per-kv-head query group
+# (GQA ratio) is often < 8; pad it up so every tile Mosaic sees is
+# (8+, 128+)-aligned. Padded rows are garbage and sliced off at the end.
+_MIN_SUBLANES = 8
+
+
+def _decode_kernel(cur_ref, pad_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_ref, m_ref, l_ref, *, sm_scale: float, h_kv: int,
+                   block_k: int):
+    """Grid = (B·Hkv, KV blocks); KV blocks stream through VMEM via the
+    innermost grid dimension. Scratch: (G, D) f32 accumulator + (G, LANES)
+    running max/normalizer, persistent across KV steps."""
+    bh, j = pl.program_id(0), pl.program_id(1)
+    n_kv = pl.num_programs(1)
+    cur = cur_ref[0]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    @pl.when(j * block_k < cur)
+    def _update():
+        q = q_ref[0].astype(jnp.float32) * sm_scale      # (G, D)
+        k = k_ref[0].astype(jnp.float32)                 # (BK, D)
+        v = v_ref[0].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # (G, BK)
+        col = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)[0]
+        # live slots: written (col < cur) and past this row's left pad
+        pad_len = pad_ref[bh // h_kv]
+        valid = (col < cur) & (col >= pad_len)
+        s = jnp.where(valid[None, :], s, NEG_INF)
+        m_prev = m_ref[:, 0]
+        l_prev = l_ref[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        p = jnp.where(m_new[:, None] <= NEG_INF, 0.0, p)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+
+    @pl.when(j == n_kv - 1)
+    def _finalize():
+        l = l_ref[:, 0]
+        safe_l = jnp.where(l > 0, l, 1.0)  # unreachable rows (cur == 0)
+        o_ref[0] = (acc_ref[:] / safe_l[:, None]).astype(o_ref.dtype)
+
+
+def supports(max_len: int, block_k: int = _LANES) -> bool:
+    """Whether the kernel covers a cache of ``max_len`` slots: KV blocks
+    must tile it exactly (the dead-block clamp assumes whole blocks).
+    ``init_cache`` sizes are user-chosen; non-multiples fall back to the
+    dense path at the call site."""
+    return max_len >= block_k and max_len % block_k == 0
+
+
+def flash_decode(q, k_cache, v_cache, cur, pad_lens=None, *,
+                 block_k: int | None = None, interpret: bool | None = None):
+    """Single-step cache attention. ``q``: ``[B, Hq, 1, D]`` (the decode
+    token's queries), ``k_cache``/``v_cache``: ``[B, Hkv, L, D]`` with
+    ``Hq % Hkv == 0`` (GQA), ``cur``: scalar int32 — slots ``>= cur`` are
+    unwritten and excluded, ``pad_lens``: optional ``[B]`` int32 — row
+    r's slots ``< pad_lens[r]`` are left-padding, excluded. Returns
+    ``[B, Hq, 1, D]``.
+
+    HBM traffic per step is ``O(cur)``, not ``O(L)``: blocks at or past
+    ``cur`` are clamped to the last live block in the index map (DMA
+    skipped for the repeat) and their compute is ``pl.when``-gated off.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, hq, s1, d = q.shape
+    _, h_kv, max_len, _ = k_cache.shape
+    if s1 != 1:
+        raise ValueError(f"flash_decode is single-token (got S={s1})")
+    if hq % h_kv:
+        raise ValueError(f"Hq={hq} not a multiple of Hkv={h_kv}")
+    bk = _LANES if block_k is None else block_k
+    if not supports(max_len, bk):
+        raise ValueError(
+            f"cache len {max_len} not tiled by block_k={bk}; use the "
+            f"dense path (see supports())")
+    rep = hq // h_kv
+    g = max(rep, _MIN_SUBLANES)
+    sm_scale = 1.0 / math.sqrt(d)
+
+    # [B, Hq, 1, D] → [B·Hkv, G, D]: kv-head-major so each program's query
+    # block is exactly that head's GQA group (padded to >= 8 sublanes).
+    q3 = q.reshape(b, h_kv, rep, d)
+    if g != rep:
+        q3 = jnp.pad(q3, ((0, 0), (0, 0), (0, g - rep), (0, 0)))
+    q3 = q3.reshape(b * h_kv, g, d)
+    k3 = k_cache.reshape(b * h_kv, max_len, d)
+    v3 = v_cache.reshape(b * h_kv, max_len, d)
+    cur_arr = jnp.full((1,), cur, jnp.int32)
+    pad_arr = (jnp.zeros((b,), jnp.int32) if pad_lens is None
+               else pad_lens.astype(jnp.int32))
+
+    def kv_index(bh, j, cur_ref, pad_ref):
+        # Dead blocks re-reference the last live block: consecutive equal
+        # indices skip the HBM fetch, so the dead tail costs no bandwidth.
+        last_live = jnp.maximum(pl.cdiv(cur_ref[0], bk) - 1, 0)
+        return (bh, jnp.minimum(j, last_live), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b * h_kv, max_len // bk),
+        in_specs=[
+            pl.BlockSpec((1, g, d), lambda bh, j, c, p: (bh, 0, 0)),
+            pl.BlockSpec((1, bk, d), kv_index),
+            pl.BlockSpec((1, bk, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, g, d), lambda bh, j, c, p: (bh, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((g, d), jnp.float32),        # acc
+            pltpu.VMEM((g, _LANES), jnp.float32),   # running max m
+            pltpu.VMEM((g, _LANES), jnp.float32),   # normalizer l
+        ],
+    )
+    o3 = pl.pallas_call(
+        functools.partial(_decode_kernel, sm_scale=sm_scale, h_kv=h_kv,
+                          block_k=bk),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h_kv, g, d), q.dtype),
+        interpret=_resolve(interpret),
+    )(cur_arr, pad_arr, q3, k3, v3)
+    o = o3.reshape(b, h_kv, g, d)[:, :, :rep]
+    return o.reshape(b, hq, 1, d)
+
+
+def decode_fn_for(attn_fn):
+    """Call-site resolver (``models.llama.LlamaAttention``): the cache
+    decode kernel pairs with the flash prefill kernel — when the model's
+    resolved ``attn_fn`` is :func:`ops.flash_attention.flash_attention`
+    (explicitly, or via the ``"auto"``-on-TPU default), per-token decode
+    steps run through :func:`flash_decode`; any other attention (dense,
+    ring/Ulysses — sequence-sharded KV doesn't apply to a replicated
+    cache) keeps the in-model dense cache path. Disable explicitly with
+    ``SPARKDL_FLASH_DECODE=0`` (ablation lever for the bench)."""
+    import os
+    if os.environ.get("SPARKDL_FLASH_DECODE", "1") == "0":
+        return None
+    from .flash_attention import flash_attention
+    if attn_fn is flash_attention:
+        return flash_decode
+    return None

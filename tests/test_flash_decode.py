@@ -1,0 +1,183 @@
+"""Cache-aware flash decode kernel: numerical equivalence vs the dense
+cache path (interpret mode — the same kernel the chip compiles), plus
+the generation-level token-equality proof once wired into the model."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparkdl_tpu.ops.flash_decode import (decode_fn_for, flash_decode,
+                                          supports)
+
+
+def dense_cache_attention(q, k_cache, v_cache, cur, pad_lens=None):
+    """The in-model dense path's math (models/llama.py grouped einsum),
+    restated independently: full-cache scores, slots >= cur and slots
+    < pad_lens[b] masked out."""
+    b, hq, _, d = q.shape
+    _, h_kv, max_len, _ = k_cache.shape
+    rep = hq // h_kv
+    qg = q.reshape(b, h_kv, rep, 1, d).astype(jnp.float32)
+    kf = k_cache.astype(jnp.float32)
+    s = jnp.einsum("bgrqd,bgkd->bgrqk", qg, kf) / np.sqrt(d)
+    col = jnp.arange(max_len)[None, :]
+    valid = col < cur  # [1, max_len]
+    if pad_lens is not None:
+        valid = valid & (col >= pad_lens[:, None])  # [B, max_len]
+        valid = valid[:, None, None, None]
+    s = jnp.where(valid, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bgrqk,bgkd->bgrqd", p,
+                   v_cache.astype(jnp.float32))
+    return o.reshape(b, hq, 1, d)
+
+
+def _rand(key, shape, dtype=jnp.float32):
+    return jax.random.normal(key, shape, dtype)
+
+
+@pytest.mark.parametrize("rep", [1, 4])
+@pytest.mark.parametrize("cur", [1, 77, 256])
+def test_matches_dense_cache_attention(rep, cur):
+    b, h_kv, max_len, d = 3, 2, 256, 32
+    ks = jax.random.split(jax.random.PRNGKey(cur * 10 + rep), 3)
+    q = _rand(ks[0], (b, h_kv * rep, 1, d))
+    k = _rand(ks[1], (b, h_kv, max_len, d))
+    v = _rand(ks[2], (b, h_kv, max_len, d))
+    got = flash_decode(q, k, v, jnp.int32(cur), interpret=True)
+    want = dense_cache_attention(q, k, v, cur)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_left_pad_rows_are_excluded():
+    b, h_kv, rep, max_len, d = 4, 2, 2, 384, 16
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = _rand(ks[0], (b, h_kv * rep, 1, d))
+    k = _rand(ks[1], (b, h_kv, max_len, d))
+    v = _rand(ks[2], (b, h_kv, max_len, d))
+    pad = jnp.array([0, 3, 130, 200], jnp.int32)
+    cur = jnp.int32(260)
+    got = flash_decode(q, k, v, cur, pad, interpret=True)
+    want = dense_cache_attention(q, k, v, 260, pad)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+    # and the mask matters: row with pad=200 differs from its unpadded run
+    unpadded = flash_decode(q, k, v, cur, interpret=True)
+    assert not np.allclose(got[3], unpadded[3], atol=1e-3)
+
+
+def test_bf16_io_f32_accumulation():
+    b, h_kv, rep, max_len, d = 2, 2, 4, 128, 32
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = _rand(ks[0], (b, h_kv * rep, 1, d)).astype(jnp.bfloat16)
+    k = _rand(ks[1], (b, h_kv, max_len, d)).astype(jnp.bfloat16)
+    v = _rand(ks[2], (b, h_kv, max_len, d)).astype(jnp.bfloat16)
+    got = flash_decode(q, k, v, jnp.int32(100), interpret=True)
+    assert got.dtype == jnp.bfloat16
+    want = dense_cache_attention(q, k, v, 100)
+    np.testing.assert_allclose(np.asarray(got, np.float32), want,
+                               atol=2e-2, rtol=2e-2)
+
+
+def test_traced_cur_under_jit_one_signature():
+    """``cur`` is a traced scalar — one compiled program serves every
+    fill level (the generate() while_loop contract)."""
+    b, h_kv, rep, max_len, d = 2, 1, 2, 256, 16
+    ks = jax.random.split(jax.random.PRNGKey(11), 3)
+    q = _rand(ks[0], (b, h_kv * rep, 1, d))
+    k = _rand(ks[1], (b, h_kv, max_len, d))
+    v = _rand(ks[2], (b, h_kv, max_len, d))
+    traces = []
+
+    @jax.jit
+    def step(cur):
+        traces.append(1)
+        return flash_decode(q, k, v, cur, interpret=True)
+
+    for cur in [1, 64, 200, 256]:
+        got = step(jnp.int32(cur))
+        want = dense_cache_attention(q, k, v, cur)
+        np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+    assert len(traces) == 1
+
+
+def test_supports_contract():
+    assert supports(256)
+    assert supports(128)
+    assert not supports(100)   # not tiled by 128
+    assert not supports(64)    # below one block
+    with pytest.raises(ValueError):
+        flash_decode(jnp.zeros((1, 1, 1, 8)), jnp.zeros((1, 1, 100, 8)),
+                     jnp.zeros((1, 1, 100, 8)), jnp.int32(1),
+                     interpret=True)
+
+
+def test_decode_fn_resolver(monkeypatch):
+    from sparkdl_tpu.ops.flash_attention import flash_attention
+    assert decode_fn_for(flash_attention) is flash_decode
+    assert decode_fn_for(None) is None
+    assert decode_fn_for(lambda q, k, v, causal: q) is None
+    monkeypatch.setenv("SPARKDL_FLASH_DECODE", "0")
+    assert decode_fn_for(flash_attention) is None
+
+
+class TestGenerateTokenEquality:
+    """generate() with the flash attn_fn (which routes per-token decode
+    through flash_decode on every platform where the attn_fn is passed
+    explicitly) must emit exactly the tokens of the dense run."""
+
+    def test_tokens_equal_greedy(self):
+        from sparkdl_tpu.models import llama as L
+        from sparkdl_tpu.ops.flash_attention import flash_attention
+
+        cfg = L.LlamaConfig.tiny()
+        model_d = L.LlamaModel(cfg, attn_fn=None)
+        model_f = L.LlamaModel(cfg, attn_fn=flash_attention)
+        rng = jax.random.PRNGKey(0)
+        prompts = [[5, 6, 7], [9, 3, 2, 8, 1]]
+        ids, lens = L.left_pad_prompts(prompts)
+        variables = model_d.init(rng, jnp.asarray(ids))
+        kw = dict(max_new_tokens=8, temperature=0.0, pad_lens=lens,
+                  pad_to=128)
+        out_d = L.generate(model_d, variables, ids, **kw)
+        out_f = L.generate(model_f, variables, ids, **kw)
+        np.testing.assert_array_equal(np.asarray(out_d),
+                                      np.asarray(out_f))
+
+    def test_default_cache_size_rounds_up_and_stays_token_equal(self):
+        """Without pad_to, generate() rounds the cache to the kernel's
+        128-slot block multiple when flash decode would engage — the
+        default path must actually run the kernel, not silently fall
+        back to dense (round-5 review finding), and stay token-equal."""
+        from sparkdl_tpu.models import llama as L
+        from sparkdl_tpu.ops.flash_attention import flash_attention
+
+        cfg = L.LlamaConfig.tiny()
+        model_d = L.LlamaModel(cfg, attn_fn=None)
+        model_f = L.LlamaModel(cfg, attn_fn=flash_attention)
+        ids = jnp.asarray([[5, 6, 7], [9, 3, 2]], jnp.int32)
+        variables = model_d.init(jax.random.PRNGKey(2), ids)
+        kw = dict(max_new_tokens=5, temperature=0.0)  # max_len would be 8
+        out_d = L.generate(model_d, variables, ids, **kw)
+        out_f = L.generate(model_f, variables, ids, **kw)
+        np.testing.assert_array_equal(np.asarray(out_d),
+                                      np.asarray(out_f))
+
+    def test_tokens_equal_with_eos_while_loop(self):
+        from sparkdl_tpu.models import llama as L
+        from sparkdl_tpu.ops.flash_attention import flash_attention
+
+        cfg = L.LlamaConfig.tiny()
+        model_f = L.LlamaModel(cfg, attn_fn=flash_attention)
+        model_d = L.LlamaModel(cfg, attn_fn=None)
+        rng = jax.random.PRNGKey(1)
+        prompts = [[4, 5], [6, 7, 8]]
+        ids, lens = L.left_pad_prompts(prompts)
+        variables = model_d.init(rng, jnp.asarray(ids))
+        kw = dict(max_new_tokens=6, temperature=0.0, pad_lens=lens,
+                  pad_to=128, eos_id=2, return_steps=True)
+        out_d, steps_d = L.generate(model_d, variables, ids, **kw)
+        out_f, steps_f = L.generate(model_f, variables, ids, **kw)
+        np.testing.assert_array_equal(np.asarray(out_d),
+                                      np.asarray(out_f))
+        assert int(steps_d) == int(steps_f)

@@ -14,6 +14,14 @@ from sparkdl_tpu.ops import flash_attention
 from sparkdl_tpu.parallel.ring_attention import dense_attention
 from sparkdl_tpu.utils.platform import is_tpu_backend
 
+# Compiled-on-TPU runs (SPARKDL_TEST_PLATFORM=axon) compare against a dense
+# reference that XLA computes with the MXU's default f32 precision (bf16
+# passes), so elementwise agreement is ~1e-4, not 1e-6 — round-5 on-chip
+# measurement: max|Δ| 2.8e-4 on the forward. Interpret mode stays tight.
+FWD_ATOL = 2e-3 if is_tpu_backend() else 2e-5
+BWD_ATOL = 5e-3 if is_tpu_backend() else 5e-4
+MODEL_ATOL = 5e-3 if is_tpu_backend() else 1e-3
+
 
 def _rand_qkv(b=2, h=3, s=128, d=32, seed=0):
     rng = np.random.RandomState(seed)
@@ -26,7 +34,7 @@ def test_forward_matches_dense(causal):
     q, k, v = _rand_qkv()
     o = flash_attention(q, k, v, causal, block_q=64, block_k=64)
     ref = dense_attention(q, k, v, causal)
-    np.testing.assert_allclose(np.asarray(o), np.asarray(ref), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref), atol=FWD_ATOL)
 
 
 @pytest.mark.parametrize("s", [100, 96, 130, 64])
@@ -34,7 +42,7 @@ def test_ragged_sequence_lengths(s):
     q, k, v = _rand_qkv(s=s, seed=s)
     o = flash_attention(q, k, v, True, block_q=64, block_k=32)
     ref = dense_attention(q, k, v, True)
-    np.testing.assert_allclose(np.asarray(o), np.asarray(ref), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref), atol=FWD_ATOL)
 
 
 @pytest.mark.parametrize("s", [4, 37, 100, 130])
@@ -46,14 +54,14 @@ def test_ragged_with_default_blocks(s):
     o = flash_attention(q, k, v, True)
     np.testing.assert_allclose(np.asarray(o),
                                np.asarray(dense_attention(q, k, v, True)),
-                               atol=2e-5)
+                               atol=FWD_ATOL)
     lens = np.minimum([s, max(1, s // 2)], s)
     kv_mask = jnp.asarray((np.arange(s)[None, :]
                            < np.asarray(lens)[:, None]).astype(np.float32))
     o2 = flash_attention(q, k, v, False, kv_mask=kv_mask)
     np.testing.assert_allclose(
         np.asarray(o2), np.asarray(_masked_dense(q, k, v, kv_mask, False)),
-        atol=2e-5)
+        atol=FWD_ATOL)
 
 
 @pytest.mark.parametrize("causal", [False, True])
@@ -69,7 +77,7 @@ def test_gradients_match_dense(causal):
     gf = jax.grad(lf, argnums=(0, 1, 2))(q, k, v)
     gr = jax.grad(lr, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gf, gr):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=BWD_ATOL)
 
 
 def test_bf16_inputs():
@@ -87,7 +95,7 @@ def test_jit_and_blocks_smaller_than_seq():
     f = jax.jit(lambda a, b, c: flash_attention(a, b, c, True, block_q=128, block_k=64))
     np.testing.assert_allclose(np.asarray(f(q, k, v)),
                                np.asarray(dense_attention(q, k, v, True)),
-                               atol=2e-5)
+                               atol=FWD_ATOL)
 
 
 def test_llama_with_flash_attention():
@@ -102,7 +110,7 @@ def test_llama_with_flash_attention():
     flash_model = LlamaModel(cfg, attn_fn=flash_attention)
     logits_flash = flash_model.apply(variables, jnp.asarray(ids))
     np.testing.assert_allclose(np.asarray(logits_flash),
-                               np.asarray(logits_dense), atol=1e-3)
+                               np.asarray(logits_dense), atol=MODEL_ATOL)
 
 
 def _masked_dense(q, k, v, kv_mask, causal):
@@ -128,14 +136,14 @@ def test_kv_mask_matches_masked_dense(causal):
     o = flash_attention(q, k, v, causal, kv_mask=kv_mask,
                         block_q=32, block_k=32)
     ref = _masked_dense(q, k, v, kv_mask, causal)
-    np.testing.assert_allclose(np.asarray(o), np.asarray(ref), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref), atol=FWD_ATOL)
 
     gf = jax.grad(lambda a: (flash_attention(
         a, k, v, causal, kv_mask=kv_mask, block_q=32, block_k=32) ** 2)
         .sum())(q)
     gr = jax.grad(lambda a: (_masked_dense(a, k, v, kv_mask, causal) ** 2)
                   .sum())(q)
-    np.testing.assert_allclose(np.asarray(gf), np.asarray(gr), atol=5e-4)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gr), atol=BWD_ATOL)
 
 
 def test_fully_masked_rows_produce_zeros():
