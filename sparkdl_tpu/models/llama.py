@@ -589,14 +589,18 @@ def generate(model: LlamaModel, variables, prompt_ids, max_new_tokens: int,
                          f" {lp + max_new_tokens}")
     from ..ops import flash_decode as _fd
     from ..ops.flash_attention import resolve_attn_fn as _resolve_attn
-    if (_fd.decode_fn_for(_resolve_attn(model.attn_fn)) is not None
+    if (pad_to is None
+            and _fd.decode_fn_for(_resolve_attn(model.attn_fn)) is not None
             and not _fd.supports(max_len)):
-        # Round the cache up to the decode kernel's KV-block multiple so
-        # the flash decode path actually engages for default cache sizes
-        # (supports() needs 128-slot tiles); a few spare KV slots cost
-        # far less than every step reading the cache dense. An explicit
-        # pad_to that is already a multiple is left untouched.
-        max_len = ((max_len + _fd._LANES - 1) // _fd._LANES) * _fd._LANES
+        # Round the DEFAULT cache size up to the decode kernel's KV-block
+        # multiple so the flash decode path engages without an explicit
+        # pad_to; a few spare KV slots cost far less than every step
+        # reading the cache dense. An EXPLICIT pad_to is honored verbatim
+        # — callers sizing the cache to an HBM budget must get exactly
+        # what they asked for (a non-multiple then takes the dense path,
+        # by supports()).
+        max_len = ((max_len + _fd.KV_BLOCK - 1)
+                   // _fd.KV_BLOCK) * _fd.KV_BLOCK
     params = variables["params"] if "params" in variables else variables
     if rng is None:
         rng = jax.random.PRNGKey(0)

@@ -33,19 +33,29 @@ _TORCH_MEAN = (0.485, 0.456, 0.406)
 _TORCH_STD = (0.229, 0.224, 0.225)
 
 
+def _as_float(x):
+    """Integer image batches (the uint8 wire format — 4x fewer
+    host→HBM bytes than f32) upcast IN-GRAPH before the arithmetic:
+    without this, caffe's mean subtraction would run in uint8 and WRAP
+    (103.94 → 103, 90-103 → 243+), and tf's ``x/127.5`` would rely on
+    dtype promotion. XLA fuses the cast into the first op for free."""
+    return x.astype(jnp.float32) if jnp.issubdtype(x.dtype, jnp.integer) \
+        else x
+
+
 def preprocess_tf(x):
     """Scale [0,255] → [-1,1] (InceptionV3 / Xception convention)."""
-    return x / 127.5 - 1.0
+    return _as_float(x) / 127.5 - 1.0
 
 
 def preprocess_caffe(x):
     """RGB→BGR + ImageNet mean subtraction (ResNet50/VGG convention)."""
-    x = x[..., ::-1]
+    x = _as_float(x)[..., ::-1]
     return x - jnp.asarray(_CAFFE_MEAN, dtype=x.dtype)
 
 
 def preprocess_torch(x):
-    x = x / 255.0
+    x = _as_float(x) / 255.0
     return (x - jnp.asarray(_TORCH_MEAN, dtype=x.dtype)) / jnp.asarray(
         _TORCH_STD, dtype=x.dtype)
 

@@ -53,6 +53,11 @@ from .flash_attention import NEG_INF, _LANES, _resolve
 # (8+, 128+)-aligned. Padded rows are garbage and sliced off at the end.
 _MIN_SUBLANES = 8
 
+# Default KV block size = the lane width; the public name exists so
+# callers sizing a cache for the kernel (llama.generate's default-cache
+# round-up) stay in sync with supports() if the default ever changes.
+KV_BLOCK = _LANES
+
 
 def _decode_kernel(cur_ref, pad_ref, q_ref, k_ref, v_ref, o_ref,
                    acc_ref, m_ref, l_ref, *, sm_scale: float, h_kv: int,

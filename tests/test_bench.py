@@ -78,6 +78,8 @@ def test_all_metric_legs_run_end_to_end_tiny_cpu():
                 "BENCH_BERT_SEQ": "64", "BENCH_GEN_CONFIG": "tiny",
                 "BENCH_GEN_BATCH": "2", "BENCH_GEN_PROMPT": "16",
                 "BENCH_GEN_NEW": "8", "BENCH_FLASH_SEQS": "256",
+                "BENCH_GEN_LC_PROMPT": "8", "BENCH_GEN_LC_CACHE": "256",
+                "BENCH_GEN_LC_NEW": "4",
                 "BENCH_WALL_S": "900"}, timeout=900)
     assert rec["value"] > 0, rec
     assert rec["vs_baseline"] is None  # no baseline file -> null, not 1.0

@@ -9,6 +9,13 @@ import pytest
 
 from sparkdl_tpu.ops.flash_decode import (decode_fn_for, flash_decode,
                                           supports)
+from sparkdl_tpu.utils.platform import is_tpu_backend
+
+# On the real chip the dense reference itself runs through the MXU's
+# default f32 precision (bf16 passes), so agreement is ~1e-4 — the same
+# platform split as tests/test_ops.py. Interpret mode stays tight.
+ATOL = 2e-3 if is_tpu_backend() else 2e-5
+RTOL = 2e-3 if is_tpu_backend() else 2e-5
 
 
 def dense_cache_attention(q, k_cache, v_cache, cur, pad_lens=None):
@@ -47,7 +54,7 @@ def test_matches_dense_cache_attention(rep, cur):
     v = _rand(ks[2], (b, h_kv, max_len, d))
     got = flash_decode(q, k, v, jnp.int32(cur), interpret=True)
     want = dense_cache_attention(q, k, v, cur)
-    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(got, want, atol=ATOL, rtol=RTOL)
 
 
 def test_left_pad_rows_are_excluded():
@@ -60,7 +67,7 @@ def test_left_pad_rows_are_excluded():
     cur = jnp.int32(260)
     got = flash_decode(q, k, v, cur, pad, interpret=True)
     want = dense_cache_attention(q, k, v, 260, pad)
-    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(got, want, atol=ATOL, rtol=RTOL)
     # and the mask matters: row with pad=200 differs from its unpadded run
     unpadded = flash_decode(q, k, v, cur, interpret=True)
     assert not np.allclose(got[3], unpadded[3], atol=1e-3)
@@ -97,7 +104,7 @@ def test_traced_cur_under_jit_one_signature():
     for cur in [1, 64, 200, 256]:
         got = step(jnp.int32(cur))
         want = dense_cache_attention(q, k, v, cur)
-        np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+        np.testing.assert_allclose(got, want, atol=ATOL, rtol=RTOL)
     assert len(traces) == 1
 
 

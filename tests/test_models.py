@@ -115,3 +115,22 @@ def test_decode_predictions():
     assert out[0][0]["class"] == 1 and out[0][1]["class"] == 2
     assert 0 < out[0][0]["score"] <= 1
     assert out[0][0]["label"] == "class_1"
+
+
+def test_preprocess_accepts_uint8_wire_batches():
+    """uint8 batches (the 4x-cheaper wire format) must preprocess
+    identically to their f32 equivalents — caffe's mean subtraction in
+    particular must not wrap in uint8 arithmetic."""
+    import numpy as np
+    import jax.numpy as jnp
+    from sparkdl_tpu.models.registry import (preprocess_caffe,
+                                             preprocess_tf,
+                                             preprocess_torch)
+    rng = np.random.RandomState(0)
+    u8 = rng.randint(0, 256, size=(2, 8, 8, 3), dtype=np.uint8)
+    f32 = u8.astype(np.float32)
+    for fn in (preprocess_tf, preprocess_caffe, preprocess_torch):
+        a = np.asarray(fn(jnp.asarray(u8)))
+        b = np.asarray(fn(jnp.asarray(f32)))
+        assert a.dtype == np.float32, fn.__name__
+        np.testing.assert_allclose(a, b, atol=1e-6, err_msg=fn.__name__)
