@@ -16,11 +16,12 @@ if ! timeout 120 python -c "import jax; print(jax.devices())" >&2; then
 fi
 
 echo "== 2/3 bench (all legs, incl north-star scale + profile) ==" >&2
-BENCH_NORTHSTAR_ROWS="${BENCH_NORTHSTAR_ROWS:-100000}" \
+BENCH_NORTHSTAR_ROWS="${BENCH_NORTHSTAR_ROWS:-40000}" \
 BENCH_PROFILE_DIR="${BENCH_PROFILE_DIR:-bench_profile}" \
 BENCH_FLASH_BLOCKS="${BENCH_FLASH_BLOCKS:-128,256,512}" python bench.py
 
 # pytest output goes to stderr so stdout stays ONE parseable JSON record
 # (probe_loop.sh captures stdout as BENCH_TPU_MEASURED.json)
 echo "== 3/3 compiled Pallas kernel tests on the chip ==" >&2
-SPARKDL_TEST_PLATFORM=axon python -m pytest tests/test_ops.py -q >&2
+SPARKDL_TEST_PLATFORM=axon python -m pytest tests/test_ops.py \
+    tests/test_flash_decode.py -q >&2
