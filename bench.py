@@ -636,13 +636,16 @@ def _worker_flash() -> dict:
         blocks_env = os.environ.get("BENCH_FLASH_BLOCKS")
         if blocks_env:
             sweep = {}
-            # t_f above ran with the ENV-DEFAULT blocks — reuse it only
-            # for that exact config (an operator deploying the sweep's
-            # pick via SPARKDL_FLASH_BLOCK_Q/_K shifts what "default"
-            # means; blindly labeling t_f as "128" would compare a
-            # config against itself under the wrong key)
-            env_blk = (int(os.environ.get("SPARKDL_FLASH_BLOCK_Q", "128")),
-                       int(os.environ.get("SPARKDL_FLASH_BLOCK_K", "128")))
+            # t_f above ran with the DEFAULT blocks — env override if
+            # set, else the kernel's length-adaptive pick (_default_block;
+            # assuming a fixed 128 here would file the adaptive default's
+            # timing under the wrong sweep key). Reuse t_f only for that
+            # exact config.
+            from sparkdl_tpu.ops.flash_attention import _default_block
+            env_q = os.environ.get("SPARKDL_FLASH_BLOCK_Q")
+            env_k = os.environ.get("SPARKDL_FLASH_BLOCK_K")
+            env_blk = (int(env_q) if env_q else _default_block(s),
+                       int(env_k) if env_k else _default_block(s))
             for tok in blocks_env.split(","):
                 try:
                     blk = int(tok)

