@@ -435,6 +435,7 @@ def _worker_northstar() -> dict:
     import pyarrow.parquet as pq
 
     from sparkdl_tpu.models.registry import get_model
+    from sparkdl_tpu.utils.platform import backend_info
     from sparkdl_tpu.transformers.named_image import DeepImageFeaturizer
 
     rows = int(os.environ.get("BENCH_NORTHSTAR_ROWS", "0"))
@@ -491,8 +492,19 @@ def _worker_northstar() -> dict:
             "northstar_model": model_name,
             # growth of the process's peak RSS across the streamed run —
             # O(batch) streaming keeps this far below the materialized
-            # input size, which is the line item that proves the claim
+            # input size, which is the line item that proves the claim.
+            # CAVEAT on axon: the experimental PJRT client leaks host RSS
+            # on EVERY host→device transfer (~the payload size per
+            # device_put; minimal repro in
+            # scripts/axon_transfer_leak_probe.py), so on that backend
+            # this line reads ~bytes-transferred, not framework
+            # residency — the CPU-backend in-suite pin is the framework's
+            # own number (tests/test_bench.py northstar test).
             "northstar_peak_rss_delta_mb": (rss1_kb - rss0_kb) / 1024,
+            **({"northstar_rss_caveat":
+                "axon client leaks per-transfer host staging; see "
+                "scripts/axon_transfer_leak_probe.py"}
+               if backend_info().get("is_tpu") else {}),
             "northstar_input_mb_if_materialized": rows * h * w * 3 / 1e6,
             "northstar_sink_mb": sink_mb}
 

@@ -1,0 +1,48 @@
+"""Minimal repro: the axon PJRT client leaks host RSS on every
+host->device transfer (round-5 finding, 2026-07-31).
+
+A bare device_put -> jitted compute -> del loop, with every Python
+reference dropped and the result blocked, grows host RSS by exactly the
+transfer payload per iteration (measured 32.7 MB/iter for a 34 MB
+batch; same growth via implicit jit-argument transfer and with
+donate_argnums). The framework's own data plane is O(batch): the same
+streaming path holds RSS flat on the CPU backend
+(tests/test_bench.py::test_northstar_leg_streams_in_o_batch_memory),
+so sustained-throughput RSS growth on axon (e.g. the bench north-star
+leg's ~490 KB/row) is client staging, not framework residency.
+
+Run: python scripts/axon_transfer_leak_probe.py  (needs the axon TPU)
+"""
+
+import numpy as np
+
+
+def rss_mb() -> float:
+    with open("/proc/self/status") as f:
+        return int(f.read().split("VmRSS:")[1].split()[0]) / 1024
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    x = np.random.randint(0, 255, size=(128, 299, 299, 3), dtype=np.uint8)
+    payload_mb = x.nbytes / 1e6
+    f = jax.jit(lambda a: (a.astype(jnp.float32) / 255.0).sum(axis=(1, 2, 3)))
+    jax.block_until_ready(f(jax.device_put(x)))  # compile + first transfer
+    r0 = rss_mb()
+    iters = 30
+    for _ in range(iters):
+        d = jax.device_put(x)
+        o = f(d)
+        jax.block_until_ready(o)
+        del d, o
+    delta = rss_mb() - r0
+    print(f"payload {payload_mb:.1f} MB x {iters} transfers -> "
+          f"RSS delta {delta:.0f} MB ({delta / iters:.1f} MB/transfer)")
+    if delta > 0.5 * payload_mb * iters:
+        print("LEAK: client retains ~every transfer buffer")
+
+
+if __name__ == "__main__":
+    main()
