@@ -1,0 +1,86 @@
+"""Characterize the flash-bench timing anomaly on the axon TPU.
+
+BENCH_TPU_MEASURED2's flash leg shows the first two timings of the leg at
+~0.04 ms and every later timing (any kernel, any seq) at ~13 ms — a pattern
+that tracks *position in the run*, not the computation.  This probe times
+dense and flash attention at S=512/1024 three ways to separate real kernel
+time from dispatch/tunnel artifacts:
+
+  amortized  - dispatch N calls back-to-back, block once at the end
+               (the bench harness's method)
+  percall    - block_until_ready after every call
+  chained    - feed each output back in as the next q, forcing a data
+               dependency so the device can't overlap queue slots
+
+Run: timeout 600 python scripts/flash_timing_probe.py
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparkdl_tpu.ops.flash_attention import flash_attention
+from sparkdl_tpu.parallel.ring_attention import dense_attention
+from sparkdl_tpu.utils.platform import is_tpu_backend
+
+REPS = 20
+
+
+def amortized(fn, *args):
+    o = fn(*args)
+    jax.block_until_ready(o)
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        o = fn(*args)
+    jax.block_until_ready(o)
+    return (time.perf_counter() - t0) / REPS
+
+
+def percall(fn, *args):
+    o = fn(*args)
+    jax.block_until_ready(o)
+    ts = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return min(ts), sum(ts) / len(ts)
+
+
+def chained(fn, q, k, v):
+    o = fn(q, k, v)
+    jax.block_until_ready(o)
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        o = fn(o, k, v)
+    jax.block_until_ready(o)
+    return (time.perf_counter() - t0) / REPS
+
+
+def main():
+    compiled = is_tpu_backend()
+    print("backend", jax.devices()[0].platform, "compiled", compiled, flush=True)
+    for s in (512, 1024):
+        rng = np.random.RandomState(s)
+        q, k, v = [jnp.asarray(rng.randn(2, 8, s, 64).astype(np.float32) * .3)
+                   for _ in range(3)]
+        flash = jax.jit(lambda a, b, c: flash_attention(
+            a, b, c, causal=True, interpret=not compiled))
+        dense = jax.jit(lambda a, b, c: dense_attention(a, b, c, True))
+        for name, fn in (("dense", dense), ("flash", flash)):
+            am = amortized(fn, q, k, v)
+            pc_min, pc_mean = percall(fn, q, k, v)
+            ch = chained(fn, q, k, v)
+            print(f"S={s} {name}: amortized {am*1e3:.3f}ms  "
+                  f"percall min {pc_min*1e3:.3f} mean {pc_mean*1e3:.3f}ms  "
+                  f"chained {ch*1e3:.3f}ms", flush=True)
+        # Re-time the FIRST kernel again at the END: if position in the
+        # run (not the kernel) sets the time, this re-run shows it.
+        am2 = amortized(dense, q, k, v)
+        print(f"S={s} dense re-timed at end: amortized {am2*1e3:.3f}ms",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -265,11 +265,13 @@ def flash_attention(q, k, v, causal: bool = False, *, kv_mask=None,
     and ``BertEncoder(attn_fn=…)``.
 
     ``block_q``/``block_k`` default from ``SPARKDL_FLASH_BLOCK_Q``/``_K``
-    when set, else adapt to the sequence length — the round-5 on-chip
-    sweep (bench flash leg, v5e) measured s512 fastest at 128-blocks
-    (0.027ms vs 13.3ms at 256) but s1024 fastest at 512-blocks (6.7ms vs
-    13.6ms at 128): one fixed default forfeits ~2x at the other length.
-    The bench's flash leg still sweeps via ``BENCH_FLASH_BLOCKS``.
+    when set, else from ``_default_block``'s measured cost model — the
+    round-5 on-chip sweep with a trustworthy barrier (fetch-closed scan
+    chains; bench flash leg) measured 512-blocks fastest at EVERY swept
+    length (s512 0.042 ms vs 0.107 at 128; s2048 0.43 vs 1.34), so the
+    default prefers the largest block unless the padding it forces on a
+    ragged length outweighs its per-work advantage.  The bench's flash
+    leg still sweeps via ``BENCH_FLASH_BLOCKS``.
     """
     import os
     s_len = q.shape[2]
@@ -288,17 +290,27 @@ def flash_attention(q, k, v, causal: bool = False, *, kv_mask=None,
                        _resolve(interpret))
 
 
+# Relative per-unit-work kernel speed by block size, measured on TPU v5
+# lite (round-5 bench flash leg, fetch-closed scan-chain timing): 512-
+# blocks run ~2.5x faster per tile-work than 128, 256 ~1.45x — fewer grid
+# steps, better DMA amortization, and the MXU fed 512-row tiles.
+_BLOCK_SPEED = {128: 1.0, 256: 1.45, 512: 2.5}
+
+
 def _default_block(s_len: int) -> int:
-    """Sequence-length-adaptive block default, from the on-chip sweep:
-    short sequences want small blocks (less dead causal work per tile,
-    more grid parallelism), long ones want big blocks (fewer grid steps,
-    better DMA amortization). Crossover measured between 512 and 1024 on
-    TPU v5 lite. 512 is picked only when it adds no padding beyond the
-    128-block baseline (s_pad rounds to lcm(bq, bk)): at e.g. s=1025 a
-    512-block would pad to 1536 — ~33% extra MXU/HBM work — where
-    128-blocks pad to 1152."""
+    """Pick the block minimizing estimated cost = (padded work) / (per-
+    work speed).  Bigger blocks are uniformly faster per unit work on v5e
+    (see _BLOCK_SPEED), but a ragged length pads up to the block multiple
+    and the extra tiles are real MXU/HBM work: at s=640 a 512-block pads
+    to 1024 (2.56x the tile area) and loses to 256; at s=1152 even the
+    33% pad of a 512-block wins on its 2.5x speed."""
     s128 = pl.cdiv(s_len, _LANES) * _LANES
-    return 512 if s_len >= 1024 and s128 % 512 == 0 else 128
+
+    def cost(blk):
+        padded = pl.cdiv(s128, blk) * blk
+        return (padded / s128) ** 2 / _BLOCK_SPEED[blk]
+
+    return min((512, 256, 128), key=cost)
 
 
 def _resolve(interpret: bool | None) -> bool:
@@ -308,8 +320,42 @@ def _resolve(interpret: bool | None) -> bool:
     return interpret
 
 
+def dense_attention_masked(q, k, v, causal: bool = False, kv_mask=None):
+    """The short-sequence arm of :func:`adaptive_attention`: delegates to
+    ``parallel.ring_attention.dense_attention`` (ONE source of truth for
+    the reference numerics, including the flash kernel's fully-masked-
+    row-outputs-zeros contract)."""
+    from ..parallel.ring_attention import dense_attention
+    return dense_attention(q, k, v, causal, kv_mask)
+
+
+def _flash_min_seq() -> int:
+    import os
+    return int(os.environ.get("SPARKDL_FLASH_MIN_SEQ", "2048"))
+
+
+def adaptive_attention(q, k, v, causal: bool = False, *, kv_mask=None,
+                       interpret: bool | None = None):
+    """Length-adaptive attention: the Pallas flash kernel at and above
+    ``SPARKDL_FLASH_MIN_SEQ`` (default 2048), XLA dense attention below.
+
+    The round-5 on-chip measurements (fetch-closed scan-chain timing, v5e)
+    put the crossover between S=1024 and S=2048 for [B=2, H=8, D=64]:
+    dense 0.014/0.054/1.14 ms at S=512/1024/2048 vs flash (512-blocks)
+    0.042/0.146/0.43 ms — below the crossover XLA's fused dense attention
+    wins outright (the S^2 scores still fit VMEM tiles), above it dense
+    goes HBM-bound on the materialized scores and the streaming kernel
+    takes over.  The branch is on a static shape, so under jit each
+    sequence length traces exactly one arm."""
+    if q.shape[2] >= _flash_min_seq():
+        return flash_attention(q, k, v, causal, kv_mask=kv_mask,
+                               interpret=interpret)
+    return dense_attention_masked(q, k, v, causal, kv_mask)
+
+
 def auto_attn_fn():
-    """The default-attention policy: the compiled flash kernel on TPU,
+    """The default-attention policy: :func:`adaptive_attention` on TPU
+    (flash kernel at long S, XLA dense below the measured crossover),
     ``None`` (dense attention in-model) elsewhere. Models accept the
     returned value as their ``attn_fn``; pass through to
     ``LlamaModel(attn_fn=auto_attn_fn())`` / ``BertEncoder(attn_fn=…)``.
@@ -320,7 +366,7 @@ def auto_attn_fn():
     would silently keep dense attention on the real chip."""
     from sparkdl_tpu.utils.platform import is_tpu_backend
     if is_tpu_backend():
-        return flash_attention
+        return adaptive_attention
     return None
 
 

@@ -200,7 +200,7 @@ def decode_fn_for(attn_fn):
     import os
     if os.environ.get("SPARKDL_FLASH_DECODE", "1") == "0":
         return None
-    from .flash_attention import flash_attention
-    if attn_fn is flash_attention:
+    from .flash_attention import adaptive_attention, flash_attention
+    if attn_fn is flash_attention or attn_fn is adaptive_attention:
         return flash_decode
     return None

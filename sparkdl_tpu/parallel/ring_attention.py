@@ -33,16 +33,27 @@ from jax.sharding import Mesh, PartitionSpec as P
 NEG_INF = -1e30  # large-but-finite: -inf breaks the streaming-softmax max
 
 
-def dense_attention(q, k, v, causal: bool = False):
-    """Reference single-device attention. [B, H, S, D] layout."""
+def dense_attention(q, k, v, causal: bool = False, kv_mask=None):
+    """Reference single-device attention. [B, H, S, D] layout.
+
+    ``kv_mask`` ([B, S] 0/1) follows the flash kernel's contract exactly,
+    including the edge the streaming kernel gets for free: a row whose
+    mask is ALL zero outputs zeros, not the uniform mean(v) that finite
+    NEG_INF scores would give softmax."""
     scale = 1.0 / math.sqrt(q.shape[-1])
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     if causal:
         S = q.shape[2]
         mask = jnp.tril(jnp.ones((S, S), bool))
         s = jnp.where(mask, s, NEG_INF)
+    if kv_mask is not None:
+        valid = kv_mask.astype(bool)
+        s = jnp.where(valid[:, None, None, :], s, NEG_INF)
     p = jax.nn.softmax(s.astype(jnp.float32), axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+    if kv_mask is not None:
+        o = o * valid.any(-1).astype(o.dtype)[:, None, None, None]
+    return o
 
 
 def _ring_shard(q, k, v, *, axis_name: str, causal: bool):

@@ -4,6 +4,8 @@ Runs through the Pallas interpreter on the CPU test mesh (conftest), exactly
 the semantics the compiled TPU kernel executes.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -155,12 +157,51 @@ def test_fully_masked_rows_produce_zeros():
 
 
 def test_auto_attn_fn_policy():
-    from sparkdl_tpu.ops.flash_attention import auto_attn_fn
+    from sparkdl_tpu.ops.flash_attention import adaptive_attention, auto_attn_fn
     fn = auto_attn_fn()
     if is_tpu_backend():
-        assert fn is flash_attention
+        assert fn is adaptive_attention
     else:
         assert fn is None
+
+
+def test_adaptive_attention_arms():
+    """Both arms of the length-adaptive policy agree with the dense
+    reference, with and without kv_mask, on either side of the
+    SPARKDL_FLASH_MIN_SEQ crossover (forced low to reach the flash arm
+    at test-scale shapes)."""
+    from sparkdl_tpu.ops.flash_attention import adaptive_attention
+
+    q, k, v = _rand_qkv(s=64, seed=11)
+    ref = dense_attention(q, k, v, True)
+    # dense arm (64 < min_seq default)
+    np.testing.assert_allclose(np.asarray(adaptive_attention(q, k, v, True)),
+                               np.asarray(ref), atol=FWD_ATOL)
+    # flash arm, forced by dropping the crossover below s
+    os.environ["SPARKDL_FLASH_MIN_SEQ"] = "32"
+    try:
+        np.testing.assert_allclose(
+            np.asarray(adaptive_attention(q, k, v, True)),
+            np.asarray(ref), atol=FWD_ATOL)
+    finally:
+        del os.environ["SPARKDL_FLASH_MIN_SEQ"]
+    # kv_mask contract holds on the dense arm (flash arm's is kernel-tested)
+    kv_mask = jnp.asarray(np.r_[np.ones(40), np.zeros(24)][None, :]
+                          .repeat(2, 0).astype(np.float32))
+    got = adaptive_attention(q, k, v, False, kv_mask=kv_mask)
+    sc = np.einsum("bhqd,bhkd->bhqk",
+                   np.asarray(q), np.asarray(k)) / np.sqrt(q.shape[-1])
+    sc = np.where(np.asarray(kv_mask)[:, None, None, :] > 0, sc, -1e30)
+    p = np.exp(sc - sc.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    want = np.einsum("bhqk,bhkd->bhqd", p, np.asarray(v))
+    np.testing.assert_allclose(np.asarray(got), want, atol=FWD_ATOL)
+    # fully-masked rows output ZEROS on the dense arm too — the flash
+    # kernel's contract (test_fully_masked_rows_produce_zeros), which a
+    # finite NEG_INF softmax would otherwise turn into mean(v)
+    all_dead = jnp.zeros((2, 64))
+    o0 = adaptive_attention(q, k, v, False, kv_mask=all_dead)
+    np.testing.assert_allclose(np.asarray(o0), 0.0, atol=1e-6)
 
 
 def test_is_tpu_device_recognizes_axon():

@@ -420,14 +420,20 @@ class TestBertFlashAndDataFrame:
 
 
 def test_bert_maskless_attn_fn_contract():
-    """A plain (q,k,v,causal=...) attn_fn (ring/Ulysses/dense signature)
-    works when no attention_mask is given; with a padding mask it raises a
-    clear error instead of silently ignoring the padding (code-review r3)."""
+    """A plain (q,k,v,causal=...) attn_fn (the ring/Ulysses signature —
+    ring_attention.dense_attention itself grew kv_mask support in r5, so a
+    bare lambda stands in) works when no attention_mask is given; with a
+    padding mask it raises a clear error instead of silently ignoring the
+    padding (code-review r3)."""
     from sparkdl_tpu.parallel.ring_attention import dense_attention
+
+    def maskless_attn(q, k, v, causal=False):
+        return dense_attention(q, k, v, causal)
+
     cfg = BertConfig.tiny()
     ids = np.random.RandomState(2).randint(0, cfg.vocab_size,
                                            (2, 16)).astype(np.int32)
-    m = BertEncoder(cfg, attn_fn=dense_attention)
+    m = BertEncoder(cfg, attn_fn=maskless_attn)
     v = m.init(jax.random.PRNGKey(0), ids)
     _, pooled = m.apply(v, ids)  # no mask: fine
     ref = BertEncoder(cfg, attn_fn=None)
