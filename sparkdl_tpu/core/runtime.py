@@ -150,16 +150,39 @@ def pad_batch(arrays: dict[str, np.ndarray] | np.ndarray, batch_size: int):
 # HBM prefetch pipeline
 # ---------------------------------------------------------------------------
 
+def transfer_workers_default() -> int:
+    """How many threads issue ``jax.device_put`` concurrently in the feed
+    pipeline (``SPARKDL_TRANSFER_WORKERS``; 0 = inline single-threaded).
+
+    On the axon tunnel ``device_put`` holds the calling thread for the
+    whole wire time (~40 MB/s measured round 5), so one thread caps the
+    feed at wire bandwidth even though compute is idle; concurrent puts
+    can pipeline the tunnel. Off-axon the put is an async DMA handoff and
+    extra threads only add overhead — hence default 0."""
+    import os
+    return int(os.environ.get("SPARKDL_TRANSFER_WORKERS", "0"))
+
+
 def prefetch_to_device(iterator: Iterable, size: int = 2,
-                       sharding: NamedSharding | None = None) -> Iterator:
+                       sharding: NamedSharding | None = None,
+                       transfer_workers: int | None = None) -> Iterator:
     """Double-buffered ``jax.device_put`` — the HBM feed pipeline.
 
     Eagerly transfers up to ``size`` pytrees ahead of the consumer, so
     host→device DMA of the next batch overlaps with device compute on the
     current one. With a ``sharding``, each leaf is placed sharded across the
     mesh (multi-chip feeding over ICI); otherwise onto the default device.
+
+    ``transfer_workers`` > 0 issues the puts from a thread pool (consumed
+    strictly in order): when a put blocks its calling thread for the wire
+    time (the axon tunnel), N workers keep N transfers in flight. Default
+    from ``SPARKDL_TRANSFER_WORKERS`` (0 = inline). NOTE: with workers >
+    size the in-flight depth rises to ``workers`` (idle threads would
+    defeat the knob's purpose) — budget host/HBM headroom for
+    ``max(size, workers)`` batches when enabling it.
     """
-    queue: collections.deque = collections.deque()
+    workers = (transfer_workers_default() if transfer_workers is None
+               else transfer_workers)
 
     def put(batch):
         if sharding is not None:
@@ -168,14 +191,34 @@ def prefetch_to_device(iterator: Iterable, size: int = 2,
         return jax.tree_util.tree_map(jax.device_put, batch)
 
     it = iter(iterator)
-    for batch in itertools.islice(it, size):
-        queue.append(put(batch))
-    while queue:
-        out = queue.popleft()
-        nxt = next(it, None)
-        if nxt is not None:
-            queue.append(put(nxt))
-        yield out
+    queue: collections.deque = collections.deque()
+    if workers <= 0:
+        if size <= 0:  # no lookahead: plain put-and-yield, never drop rows
+            for batch in it:
+                yield put(batch)
+            return
+        for batch in itertools.islice(it, size):
+            queue.append(put(batch))
+        while queue:
+            out = queue.popleft()
+            nxt = next(it, None)
+            if nxt is not None:
+                queue.append(put(nxt))
+            yield out
+        return
+
+    from concurrent.futures import ThreadPoolExecutor
+    depth = max(size, workers)
+    with ThreadPoolExecutor(max_workers=workers,
+                            thread_name_prefix="sparkdl-put") as pool:
+        for batch in itertools.islice(it, depth):
+            queue.append(pool.submit(put, batch))
+        while queue:
+            fut = queue.popleft()
+            nxt = next(it, None)
+            if nxt is not None:
+                queue.append(pool.submit(put, nxt))
+            yield fut.result()
 
 
 def background_iter(iterator: Iterable, maxsize: int = 2) -> Iterator:

@@ -51,6 +51,45 @@ def test_prefetch_to_device_preserves_order_and_content():
         np.testing.assert_array_equal(np.asarray(b), batches[i])
 
 
+def test_prefetch_to_device_threaded_transfer_matches_inline():
+    """transfer_workers > 0 (the axon tunnel's concurrent-put mode) must
+    preserve order and content exactly like the inline path, including
+    sharded placement and an iterator shorter than the in-flight depth."""
+    for n in (1, 7):
+        batches = [np.full((2, 2), i, np.float32) for i in range(n)]
+        out = list(runtime.prefetch_to_device(iter(batches), size=2,
+                                              transfer_workers=3))
+        assert len(out) == n
+        for i, b in enumerate(out):
+            assert isinstance(b, jax.Array)
+            np.testing.assert_array_equal(np.asarray(b), batches[i])
+    mesh = runtime.make_mesh()
+    sharding = runtime.data_sharding(mesh)
+    (dev_b,) = runtime.prefetch_to_device(
+        [np.arange(16, dtype=np.float32).reshape(8, 2)],
+        sharding=sharding, transfer_workers=2)
+    assert len(dev_b.sharding.device_set) == 8
+
+
+def test_prefetch_size_zero_yields_everything():
+    """size=0 (prefetching disabled) must still stream every batch —
+    not silently drop the input."""
+    batches = [np.full((2,), i, np.float32) for i in range(3)]
+    out = list(runtime.prefetch_to_device(iter(batches), size=0))
+    assert len(out) == 3
+    for i, b in enumerate(out):
+        np.testing.assert_array_equal(np.asarray(b), batches[i])
+
+
+def test_prefetch_transfer_workers_env_default(monkeypatch):
+    monkeypatch.setenv("SPARKDL_TRANSFER_WORKERS", "2")
+    assert runtime.transfer_workers_default() == 2
+    batches = [np.full((2,), i, np.float32) for i in range(4)]
+    out = list(runtime.prefetch_to_device(iter(batches), size=2))
+    for i, b in enumerate(out):
+        np.testing.assert_array_equal(np.asarray(b), batches[i])
+
+
 def test_prefetch_sharded_across_mesh():
     mesh = runtime.make_mesh()
     sharding = runtime.data_sharding(mesh)
