@@ -287,6 +287,29 @@ def _worker_resnet50_train() -> dict:
                 dt_u8 = time.perf_counter() - t0
                 rec["streamed_u8_img_s_chip"] = (steps * n) / dt_u8 \
                     / ctx.size
+
+                # feed-lookahead twin: batch k+1's shard_batch runs in a
+                # worker thread while step k executes (the fit(
+                # feed_lookahead=1) path) — on axon the wire time then
+                # overlaps compute instead of serializing with it
+                from concurrent.futures import ThreadPoolExecutor
+                state = fresh_state()
+                for _ in range(warmup):
+                    state, m = step_fn(state, ctx.shard_batch(hosts_u8[0]))
+                _force(m["loss"])
+                with ThreadPoolExecutor(1) as pool:
+                    t0 = time.perf_counter()
+                    fut = pool.submit(ctx.shard_batch, hosts_u8[0])
+                    for i in range(steps):
+                        sharded = fut.result()
+                        if i + 1 < steps:
+                            fut = pool.submit(ctx.shard_batch,
+                                              hosts_u8[(i + 1) % 4])
+                        state, m = step_fn(state, sharded)
+                    _force(m["loss"])
+                    dt_la = time.perf_counter() - t0
+                rec["streamed_u8_lookahead_img_s_chip"] = \
+                    (steps * n) / dt_la / ctx.size
             except Exception as e:
                 rec["streamed_error"] = f"{type(e).__name__}: {e}"[:200]
             return rec
@@ -315,6 +338,8 @@ def _worker_resnet50_train() -> dict:
                 "ai_flops_per_byte": best.get("ai_flops_per_byte"),
                 "streamed_img_s_chip": best.get("streamed_img_s_chip"),
                 "streamed_u8_img_s_chip": best.get("streamed_u8_img_s_chip"),
+                "streamed_u8_lookahead_img_s_chip":
+                    best.get("streamed_u8_lookahead_img_s_chip"),
                 "sweep": results,
                 "flash_attention_default": auto_attn_fn() is not None}
 
@@ -741,9 +766,15 @@ def _worker_flash() -> dict:
 
     seqs = [int(x) for x in
             os.environ.get("BENCH_FLASH_SEQS", "512,1024").split(",")]
+    # BENCH_FLASH_DTYPE=bfloat16: the in-model wire dtype (models run
+    # bf16 QKV; the kernel upcasts tiles to f32 on the MXU) — parity
+    # tolerance scales with the wire precision
+    bf16 = os.environ.get("BENCH_FLASH_DTYPE") == "bfloat16"
+    out["dtype"] = "bfloat16" if bf16 else "float32"
     for s in seqs:
         rng = np.random.RandomState(s)
-        q, k, v = [jnp.asarray(rng.randn(2, 8, s, 64).astype(np.float32) * .3)
+        q, k, v = [jnp.asarray(rng.randn(2, 8, s, 64).astype(np.float32) * .3,
+                               dtype=jnp.bfloat16 if bf16 else jnp.float32)
                    for _ in range(3)]
         flash = jax.jit(lambda a, b, c: flash_attention(
             a, b, c, causal=True, interpret=not compiled))
@@ -754,10 +785,11 @@ def _worker_flash() -> dict:
         t_f = timed(lambda a, b, c: flash_attention(
             a, b, c, causal=True, interpret=not compiled), q, k, v)
         t_d = timed(lambda a, b, c: dense_attention(a, b, c, True), q, k, v)
-        err = float(jnp.max(jnp.abs(o_f - o_d)))
+        err = float(jnp.max(jnp.abs(
+            o_f.astype(jnp.float32) - o_d.astype(jnp.float32))))
         # accumulation error grows with softmax length (measured on chip:
         # 1.8e-3 @ S=1024, 2.1e-3 @ S=2048); a wrong kernel is O(1) off
-        tol = 2e-3 * max(1.0, s / 1024)
+        tol = (2e-2 if bf16 else 2e-3) * max(1.0, s / 1024)
         assert err < tol, f"flash/dense mismatch at S={s}: {err}"
         ms = lambda t: t * 1e3 if t is not None else None
         out[f"s{s}"] = {"max_abs_err": err, "flash_ms": ms(t_f),

@@ -15,13 +15,23 @@ if ! timeout 120 python -c "import jax; print(jax.devices())" >&2; then
     exit 1
 fi
 
-echo "== 2/3 bench (all legs, incl north-star scale + profile) ==" >&2
+echo "== 2/4 bench (all legs, incl north-star scale + profile) ==" >&2
 BENCH_NORTHSTAR_ROWS="${BENCH_NORTHSTAR_ROWS:-40000}" \
 BENCH_PROFILE_DIR="${BENCH_PROFILE_DIR:-bench_profile}" \
+BENCH_FLASH_SEQS="${BENCH_FLASH_SEQS:-512,1024,2048,4096}" \
 BENCH_FLASH_BLOCKS="${BENCH_FLASH_BLOCKS:-128,256,512}" python bench.py
+
+# bf16 flash pass (the in-model wire dtype) — separate artifact so the
+# main stdout stays ONE parseable JSON record
+echo "== 3/4 bf16 flash kernel pass -> FLASH_BF16.json ==" >&2
+BENCH_FLASH_DTYPE=bfloat16 \
+BENCH_FLASH_SEQS="${BENCH_FLASH_SEQS:-512,1024,2048,4096}" \
+BENCH_FLASH_BLOCKS="${BENCH_FLASH_BLOCKS:-128,256,512}" \
+    python bench.py --worker flash > FLASH_BF16.json || \
+    echo "bf16 flash pass failed (non-fatal)" >&2
 
 # pytest output goes to stderr so stdout stays ONE parseable JSON record
 # (probe_loop.sh captures stdout as BENCH_TPU_MEASURED.json)
-echo "== 3/3 compiled Pallas kernel tests on the chip ==" >&2
+echo "== 4/4 compiled Pallas kernel tests on the chip ==" >&2
 SPARKDL_TEST_PLATFORM=axon python -m pytest tests/test_ops.py \
     tests/test_flash_decode.py -q >&2

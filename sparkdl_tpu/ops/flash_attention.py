@@ -172,6 +172,11 @@ def _fwd(q, k, v, kv_mask, causal: bool, block_q: int, block_k: int,
             pltpu.VMEM((bq, _LANES), jnp.float32),   # running max m
             pltpu.VMEM((bq, _LANES), jnp.float32),   # normalizer l
         ],
+        # (bh, q-tile) carry no cross-step state — only the innermost kv
+        # dimension threads the (acc, m, l) scratch — so Mosaic may
+        # parallelize/reorder the outer grid freely
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q3, k3, v3, m4)
     return (o3[:, :s].reshape(b, h, s, d),

@@ -21,6 +21,7 @@ DCN carries the cross-host legs of the collectives, ICI the intra-slice legs.
 
 from __future__ import annotations
 
+import collections
 import logging
 import os
 import time
@@ -187,7 +188,8 @@ class RunnerContext:
             eval_every: int = 0, checkpoint_every: int = 0,
             log_every: int = 10, explicit_collectives: bool = False,
             resume: bool = True, profile_dir: str | None = None,
-            remat: bool = False, accum_steps: int = 1) -> dict:
+            remat: bool = False, accum_steps: int = 1,
+            feed_lookahead: int | None = None) -> dict:
         """Run a full training loop; returns {state, meter, history}.
 
         Streams ``data`` (iterator of host-numpy batch dicts), shards each
@@ -195,6 +197,14 @@ class RunnerContext:
         examples/s/chip, checkpoints every ``checkpoint_every`` steps, and
         resumes from the latest checkpoint when ``resume`` and one exists —
         the checkpoint-and-restart failure-recovery story (SURVEY.md §5.3).
+
+        ``feed_lookahead`` > 0 shards batches that many steps AHEAD from a
+        worker thread (default from ``SPARKDL_FEED_LOOKAHEAD``, 0 =
+        inline): on backends where ``device_put`` holds the calling
+        thread for the wire time (the axon tunnel), the next batch's
+        host→HBM transfer then overlaps the current step instead of
+        serializing with it. Costs ``lookahead`` extra device batches of
+        HBM.
         """
         state = TrainState.create(apply_fn or (lambda p, x: p), params, tx,
                                   model_state=model_state)
@@ -219,51 +229,103 @@ class RunnerContext:
         history: list[dict] = []
 
         data_it = iter(data)
+
+        def _crop(batch):
+            """accum tail-crop; None = skip this batch entirely."""
+            if accum_steps > 1:
+                # A ragged tail batch can't split into k equal
+                # microbatches — crop to the largest size that keeps
+                # micro_split's shard-aligned fast path: the GLOBAL
+                # batch (this LOCAL shard x num_processes, which is
+                # what jit sees) must divide accum_steps x the mesh
+                # DATA-axis size (the data axis can differ from
+                # local_device_count on TP meshes and spans all
+                # processes; this subsumes plain shardability). Per
+                # LOCAL shard that's accum_steps x the axis's
+                # per-process extent. Dropping leftover rows beats
+                # aborting the run at its last step.
+                axis = int(self.mesh.shape[self.data_axis])
+                div = accum_steps * max(
+                    1, axis // self.num_processes)
+                lead = len(jax.tree_util.tree_leaves(batch)[0])
+                keep = (lead // div) * div
+                if keep == 0:
+                    log.warning(
+                        "skipping tail batch of %d rows (< "
+                        "accum_steps x per-process data extent = %d)",
+                        lead, div)
+                    return None
+                if keep != lead:
+                    log.warning(
+                        "cropping tail batch %d -> %d rows for "
+                        "accum_steps=%d x per-process data extent %d",
+                        lead, keep, accum_steps, div // accum_steps)
+                    batch = jax.tree_util.tree_map(
+                        lambda x: x[:keep], batch)
+            return batch
+
+        lookahead = (int(os.environ.get("SPARKDL_FEED_LOOKAHEAD", "0"))
+                     if feed_lookahead is None else feed_lookahead)
+        pool = None
+        if lookahead > 0:
+            # shard_batch runs in worker threads `lookahead` steps ahead:
+            # host→HBM transfer of batch k+1 overlaps step k on backends
+            # whose device_put blocks for the wire time (axon tunnel)
+            from concurrent.futures import ThreadPoolExecutor
+            pool = ThreadPoolExecutor(max_workers=lookahead,
+                                      thread_name_prefix="sparkdl-shard")
+
+        def _staged(limit: int):
+            """(local_rows, sharded_batch) stream: crop applied, at most
+            ``limit`` batches drawn from ``data_it`` — the lookahead may
+            never consume input the step loop won't run (a reused
+            iterator must sit exactly where the inline feed leaves it)."""
+            def _one(batch):
+                return (len(jax.tree_util.tree_leaves(batch)[0]),
+                        self.shard_batch(batch))
+
+            def _cropped():
+                """Draw-on-demand: nothing is pulled from data_it past
+                the cap (checked BEFORE each next())."""
+                produced = 0
+                while produced < limit:
+                    try:
+                        batch = next(data_it)
+                    except StopIteration:
+                        return
+                    batch = _crop(batch)
+                    if batch is None:
+                        continue
+                    produced += 1
+                    yield batch
+
+            if pool is None:
+                for batch in _cropped():
+                    yield _one(batch)
+                return
+            pending: collections.deque = collections.deque()
+            for batch in _cropped():
+                pending.append(pool.submit(_one, batch))
+                while len(pending) > lookahead:
+                    yield pending.popleft().result()
+            while pending:
+                yield pending.popleft().result()
+
+        staged_it = _staged(num_steps - start_step)
         if profile_dir:
             jax.profiler.start_trace(profile_dir)
         try:
             for i in range(start_step, num_steps):
                 try:
-                    batch = next(data_it)
+                    n_local, sharded = next(staged_it)
                 except StopIteration:
                     break
-                if accum_steps > 1:
-                    # A ragged tail batch can't split into k equal
-                    # microbatches — crop to the largest size that keeps
-                    # micro_split's shard-aligned fast path: the GLOBAL
-                    # batch (this LOCAL shard x num_processes, which is
-                    # what jit sees) must divide accum_steps x the mesh
-                    # DATA-axis size (the data axis can differ from
-                    # local_device_count on TP meshes and spans all
-                    # processes; this subsumes plain shardability). Per
-                    # LOCAL shard that's accum_steps x the axis's
-                    # per-process extent. Dropping leftover rows beats
-                    # aborting the run at its last step.
-                    axis = int(self.mesh.shape[self.data_axis])
-                    div = accum_steps * max(
-                        1, axis // self.num_processes)
-                    lead = len(jax.tree_util.tree_leaves(batch)[0])
-                    keep = (lead // div) * div
-                    if keep == 0:
-                        log.warning(
-                            "skipping tail batch of %d rows (< "
-                            "accum_steps x per-process data extent = %d)",
-                            lead, div)
-                        continue
-                    if keep != lead:
-                        log.warning(
-                            "cropping tail batch %d -> %d rows for "
-                            "accum_steps=%d x per-process data extent %d",
-                            lead, keep, accum_steps, div // accum_steps)
-                        batch = jax.tree_util.tree_map(
-                            lambda x: x[:keep], batch)
                 # Multi-process: `data` yields LOCAL shards (shard_batch
                 # contract) — the global step consumed n * process_count
                 # examples, and per-chip rates divide by GLOBAL chip count.
-                n = len(jax.tree_util.tree_leaves(batch)[0]) \
-                    * self.num_processes
+                n = n_local * self.num_processes
                 with metrics_lib.step_annotation(i):
-                    state, m = step_fn(state, self.shard_batch(batch))
+                    state, m = step_fn(state, sharded)
                 # Host sync only at metering/logging boundaries; otherwise
                 # steps stay enqueued and transfers overlap compute.
                 if (i + 1) % log_every == 0 or i + 1 == num_steps:
@@ -284,6 +346,8 @@ class RunnerContext:
                                     self.shard_batch)
                     logger.log(i + 1, {f"eval_{k}": v for k, v in evm.items()})
         finally:
+            if pool is not None:
+                pool.shutdown(wait=False, cancel_futures=True)
             if profile_dir:
                 jax.profiler.stop_trace()
         jax.block_until_ready(state.params)

@@ -258,6 +258,44 @@ class TestFitLoop:
         assert losses[-1] < losses[0]
         assert res["meter"].steps == 12
 
+    def test_fit_feed_lookahead_matches_inline(self):
+        """feed_lookahead=2 (threaded shard-ahead) must consume the same
+        batches in the same order and land on bitwise-identical params as
+        the inline feed — including with accum cropping active (a skipped
+        tail batch must not desync the step count)."""
+        params, _ = _make_problem(seed=6)
+        kw = dict(loss_fn=softmax_cross_entropy_loss(), params=params,
+                  tx=optax.sgd(0.1), apply_fn=_linear_apply, log_every=100,
+                  accum_steps=2)
+
+        def ragged(seed):
+            # batch sizes 16,16,...,3 — the 3-row tail gets skipped by crop
+            for i, b in enumerate(self._data(n_batches=6, seed=seed)):
+                yield b
+            yield {"image": np.ones((3, 4), np.float32),
+                   "label": np.zeros((3,), np.int64)}
+
+        r_inline = XlaRunner(np=8).run(lambda ctx: ctx.fit(
+            data=ragged(7), num_steps=10, feed_lookahead=0, **kw))
+        r_ahead = XlaRunner(np=8).run(lambda ctx: ctx.fit(
+            data=ragged(7), num_steps=10, feed_lookahead=2, **kw))
+        assert int(r_inline["state"].step) == int(r_ahead["state"].step) == 6
+        for a, b in zip(jax.tree_util.tree_leaves(r_inline["state"].params),
+                        jax.tree_util.tree_leaves(r_ahead["state"].params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_fit_lookahead_never_overconsumes_iterator(self):
+        """A reused data iterator must sit exactly where the inline feed
+        would leave it: the lookahead may not draw batches the step loop
+        won't run (epoch-style sequential fit() calls on one iterator)."""
+        params, _ = _make_problem(seed=8)
+        it = self._data(n_batches=10)
+        XlaRunner(np=8).run(lambda ctx: ctx.fit(
+            loss_fn=softmax_cross_entropy_loss(), params=params,
+            tx=optax.sgd(0.1), apply_fn=_linear_apply, data=it,
+            num_steps=4, feed_lookahead=3, log_every=100))
+        assert sum(1 for _ in it) == 6  # 10 - exactly num_steps consumed
+
     def test_checkpoint_resume(self, tmp_path):
         """Kill-and-restart: a second fit with the same checkpoint_dir must
         resume from the saved step, not from scratch (SURVEY.md §5.3)."""
