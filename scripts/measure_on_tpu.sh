@@ -9,6 +9,13 @@
 set -u
 cd "$(dirname "$0")/.."
 
+# A full measurement window needs room: the all-legs bench (sweep with
+# streamed/u8/lookahead twins, northstar 40k rows) far exceeds bench.py's
+# driver-facing defaults (1200s wall / 480s per leg) — without these the
+# resnet leg times out twice and the window records value 0.0.
+export BENCH_WALL_S="${BENCH_WALL_S:-7200}"
+export BENCH_TIMEOUT_S="${BENCH_TIMEOUT_S:-1800}"
+
 echo "== 1/3 liveness probe ==" >&2
 if ! timeout 120 python -c "import jax; print(jax.devices())" >&2; then
     echo "backend DOWN (probe hung/failed) — not measuring" >&2

@@ -17,6 +17,7 @@ INTERVAL="${PROBE_INTERVAL_S:-600}"
 TIMEOUT="${PROBE_TIMEOUT_S:-120}"
 LOG="PROBE_LOG"
 MEASURED_MARK=".probe_measured"
+MEASURED_OUT="${PROBE_MEASURED_OUT:-BENCH_TPU_MEASURED.json}"
 
 while true; do
     start=$(date +%s)
@@ -28,7 +29,7 @@ while true; do
         echo "$ts up ${elapsed}s $(echo "$out" | tail -1)" >> "$LOG"
         if [ ! -f "$MEASURED_MARK" ]; then
             echo "$ts measuring" >> "$LOG"
-            bash scripts/measure_on_tpu.sh > BENCH_TPU_MEASURED.json 2> MEASURE_LOG
+            bash scripts/measure_on_tpu.sh > "$MEASURED_OUT" 2> MEASURE_LOG
             mrc=$?
             echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) measure_done rc=$mrc" >> "$LOG"
             [ $mrc -eq 0 ] && touch "$MEASURED_MARK"

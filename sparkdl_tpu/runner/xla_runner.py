@@ -204,7 +204,13 @@ class RunnerContext:
         thread for the wire time (the axon tunnel), the next batch's
         host→HBM transfer then overlaps the current step instead of
         serializing with it. Costs ``lookahead`` extra device batches of
-        HBM.
+        HBM. Caveat: if the run raises mid-loop (step OOM, injected
+        failure), up to ``lookahead + 1`` prefetched batches have already
+        been drawn from ``data`` and are dropped with it — a caller that
+        reuses one iterator across fit() calls for exact resume semantics
+        on the ERROR path should keep the default inline feed (the
+        exactly-where-the-inline-feed-leaves-it guarantee holds only on
+        normal completion / StopIteration).
         """
         state = TrainState.create(apply_fn or (lambda p, x: p), params, tx,
                                   model_state=model_state)

@@ -35,18 +35,38 @@ def _run(env_extra: dict, timeout: float = 120) -> dict:
     return json.loads(lines[-1])
 
 
-def test_hung_backend_yields_error_record_fast():
+def test_hung_backend_yields_error_record_fast(tmp_path):
     """Simulated hang (every worker sleeps): the record must print within
-    roughly the probe timeout, with the outage machine-readable."""
+    roughly the probe timeout, with the outage machine-readable — and it
+    must embed the newest on-chip evidence (fixture-fed: the test does
+    not depend on which artifacts a checkout happens to carry)."""
+    for idx, val in (("", 111.0), ("2", 222.0)):
+        (tmp_path / f"BENCH_TPU_MEASURED{idx}.json").write_text(json.dumps(
+            {"metric": "resnet50_dp_train_throughput", "value": val,
+             "unit": "img/s/chip",
+             "extra": {"backend": {"is_tpu": True}, "mfu": 0.3,
+                       "git_rev": "abc123"}}))
+    (tmp_path / "PROBE_LOG").write_text(
+        "2026-07-30T16:21:58Z down 120s probe-hung\n"
+        "2026-07-30T20:55:00Z up 5s 1 tpu TPU v5 lite\n")
     t0 = time.monotonic()
     rec = _run({"BENCH_FAKE_HANG_S": "300", "BENCH_PROBE_TIMEOUT_S": "5",
-                "BENCH_WALL_S": "60"})
+                "BENCH_WALL_S": "60",
+                "BENCH_MEASURED_DIR": str(tmp_path),
+                "BENCH_PROBE_LOG_PATH": str(tmp_path / "PROBE_LOG")})
     wall = time.monotonic() - t0
     assert rec["value"] == 0.0
     assert rec["vs_baseline"] == 0.0
     assert rec["error"]["kind"] == "backend_unavailable"
     assert rec["extra"]["probe_error"]["kind"] == "timeout"
     assert wall < 30, f"error record took {wall:.0f}s"
+    # Self-contained outage evidence: highest filename index wins (git
+    # checkouts do not preserve mtimes), probe history summarized.
+    lm = rec["extra"]["last_measured"]
+    assert lm["file"] == "BENCH_TPU_MEASURED2.json" and lm["value"] == 222.0
+    assert rec["extra"]["probe_log"] == {
+        "attempts": 2, "ups": 1, "first": "2026-07-30T16:21:58Z",
+        "last": "2026-07-30T20:55:00Z"}
 
 
 def test_exhausted_budget_yields_error_record():
