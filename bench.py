@@ -1067,12 +1067,18 @@ def _last_measured_summary() -> dict | None:
     mdir = os.environ.get("BENCH_MEASURED_DIR") or _HERE
     best: tuple[int, dict] | None = None
     for path in glob.glob(os.path.join(mdir, "BENCH_TPU_MEASURED*.json")):
+        # Per-file hardening mirrors _probe_log_summary: this runs on the
+        # backend-outage error path whose contract is "the record always
+        # prints" — a malformed artifact (non-dict JSON, extra: null, the
+        # partial files an aborted measure can leave) must be skipped,
+        # never crash the error record.
         try:
             rec = json.load(open(path))
-        except (ValueError, OSError):
-            continue
-        ex = rec.get("extra", {})
-        if not (rec.get("value") and ex.get("backend", {}).get("is_tpu")):
+            ex = rec.get("extra") or {}
+            if not (rec.get("value") and
+                    (ex.get("backend") or {}).get("is_tpu")):
+                continue
+        except Exception:
             continue
         # "Newest" = highest filename index (MEASURED < MEASURED2 < ...):
         # git checkouts do not preserve mtimes, the filenames do encode

@@ -16,7 +16,7 @@ cd "$(dirname "$0")/.."
 export BENCH_WALL_S="${BENCH_WALL_S:-7200}"
 export BENCH_TIMEOUT_S="${BENCH_TIMEOUT_S:-1800}"
 
-echo "== 1/3 liveness probe ==" >&2
+echo "== 1/4 liveness probe ==" >&2
 if ! timeout 120 python -c "import jax; print(jax.devices())" >&2; then
     echo "backend DOWN (probe hung/failed) — not measuring" >&2
     exit 1
@@ -38,7 +38,8 @@ BENCH_FLASH_BLOCKS="${BENCH_FLASH_BLOCKS:-128,256,512}" \
     echo "bf16 flash pass failed (non-fatal)" >&2
 
 # pytest output goes to stderr so stdout stays ONE parseable JSON record
-# (probe_loop.sh captures stdout as BENCH_TPU_MEASURED.json)
+# (probe_loop.sh captures stdout as $PROBE_MEASURED_OUT,
+#  default BENCH_TPU_MEASURED.json)
 echo "== 4/4 compiled Pallas kernel tests on the chip ==" >&2
 SPARKDL_TEST_PLATFORM=axon python -m pytest tests/test_ops.py \
     tests/test_flash_decode.py -q >&2

@@ -29,10 +29,18 @@ while true; do
         echo "$ts up ${elapsed}s $(echo "$out" | tail -1)" >> "$LOG"
         if [ ! -f "$MEASURED_MARK" ]; then
             echo "$ts measuring" >> "$LOG"
-            bash scripts/measure_on_tpu.sh > "$MEASURED_OUT" 2> MEASURE_LOG
+            # Stage to a temp file: an aborted/killed measure (the rc=143
+            # events in PROBE_LOG) must never clobber an earlier window's
+            # good record with partial output.
+            bash scripts/measure_on_tpu.sh > "$MEASURED_OUT.tmp" 2> MEASURE_LOG
             mrc=$?
             echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) measure_done rc=$mrc" >> "$LOG"
-            [ $mrc -eq 0 ] && touch "$MEASURED_MARK"
+            if [ $mrc -eq 0 ]; then
+                mv "$MEASURED_OUT.tmp" "$MEASURED_OUT"
+                touch "$MEASURED_MARK"
+            else
+                mv "$MEASURED_OUT.tmp" "$MEASURED_OUT.failed" 2>/dev/null
+            fi
         fi
     elif [ $rc -eq 124 ]; then
         echo "$ts down ${elapsed}s probe-hung" >> "$LOG"

@@ -198,6 +198,11 @@ class RunnerContext:
         resumes from the latest checkpoint when ``resume`` and one exists —
         the checkpoint-and-restart failure-recovery story (SURVEY.md §5.3).
 
+        A tail batch skipped/cropped by ``accum_steps`` alignment does not
+        consume a step slot: the loop draws a replacement batch, so it
+        always runs ``num_steps`` steps when the data suffices (before
+        round 5 a skipped batch silently burned its step).
+
         ``feed_lookahead`` > 0 shards batches that many steps AHEAD from a
         worker thread (default from ``SPARKDL_FEED_LOOKAHEAD``, 0 =
         inline): on backends where ``device_put`` holds the calling

