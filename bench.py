@@ -865,6 +865,14 @@ def _worker_generate() -> dict:
     model = LlamaModel(cfg, dtype=jnp.bfloat16)
     variables = jax.jit(model.init)(jax.random.PRNGKey(0),
                                     jnp.asarray(ids[:1]))
+    # Serving-dtype weight cast (registerGenerationUDF params_dtype):
+    # decode is weight-HBM-bound, so f32-stored params would both halve
+    # the roofline below and make XLA re-cast+spill the whole tree per
+    # dispatch. BENCH_GEN_PARAMS_DTYPE=float32 opts back out.
+    params_dtype = os.environ.get("BENCH_GEN_PARAMS_DTYPE", "bfloat16")
+    if params_dtype != "float32":
+        from sparkdl_tpu.models.pretrained import cast_float_leaves
+        variables = cast_float_leaves(variables, params_dtype)
 
     # Warm BOTH signatures (full and 1-token) so the decode-only number
     # below is compile-free. Decode rate = extra tokens / extra time over
@@ -897,7 +905,23 @@ def _worker_generate() -> dict:
     # rather than a nonsense rate.
     decode_s = (b * (new - 1) / (dt - dt1)) if dt - dt1 > 1e-4 else None
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(variables))
+    # Decode roofline: every step re-reads the whole parameter set from
+    # HBM (batch 8's activations are noise next to it), so the decode
+    # rate is bounded by b * HBM_bw / param_bytes, with param_bytes from
+    # the tree as STORED (post-cast above). Per-step KV-cache reads add
+    # to the true denominator, so the bound is optimistic. Provenance
+    # note for records WITHOUT gen_params_dtype (windows 1-3): weights
+    # were stored f32 and window 3's 2641 tok/s beat the f32-read bound
+    # (~1848) — XLA hoists the per-dispatch f32→bf16 cast out of the
+    # decode loop, so steps actually read bf16; storing bf16 (the
+    # default now) makes stored == read and the recorded bound
+    # meaningful.
+    hbm = float(os.environ.get("BENCH_HBM_GBPS", "819")) * 1e9
+    param_bytes = sum(x.size * x.dtype.itemsize
+                      for x in jax.tree_util.tree_leaves(variables))
     rec = {"gen_decode_tokens_s": decode_s,
+           "gen_decode_roofline_tokens_s": b * hbm / param_bytes,
+           "gen_params_dtype": params_dtype,
            "gen_e2e_tokens_s": b * new / dt, "gen_batch": b,
            "gen_prompt_len": lp, "gen_new_tokens": new,
            "gen_wall_s": dt, "gen_prefill_plus_1_s": dt1,

@@ -27,15 +27,31 @@ BENCH_NORTHSTAR_ROWS="${BENCH_NORTHSTAR_ROWS:-40000}" \
 BENCH_PROFILE_DIR="${BENCH_PROFILE_DIR:-bench_profile}" \
 BENCH_FLASH_SEQS="${BENCH_FLASH_SEQS:-512,1024,2048,4096}" \
 BENCH_FLASH_BLOCKS="${BENCH_FLASH_BLOCKS:-128,256,512}" python bench.py
+brc=$?
+if [ $brc -ne 0 ]; then
+    # The script's exit code gates probe_loop.sh's promote-the-record mv
+    # AND its .probe_measured mark: a failed bench must fail the whole
+    # script, or a later-passing pytest step would return rc=0 and a
+    # partial record would be promoted over a good one — permanently,
+    # since the mark also ends re-measurement for the round.
+    echo "bench FAILED rc=$brc — not promoting a partial record" >&2
+    exit $brc
+fi
 
 # bf16 flash pass (the in-model wire dtype) — separate artifact so the
-# main stdout stays ONE parseable JSON record
+# main stdout stays ONE parseable JSON record. Staged via tmp + mv for
+# the same reason as the main record: a kill mid-leg must not truncate
+# a previous window's good FLASH_BF16.json.
 echo "== 3/4 bf16 flash kernel pass -> FLASH_BF16.json ==" >&2
-BENCH_FLASH_DTYPE=bfloat16 \
-BENCH_FLASH_SEQS="${BENCH_FLASH_SEQS:-512,1024,2048,4096}" \
-BENCH_FLASH_BLOCKS="${BENCH_FLASH_BLOCKS:-128,256,512}" \
-    python bench.py --worker flash > FLASH_BF16.json || \
+if BENCH_FLASH_DTYPE=bfloat16 \
+   BENCH_FLASH_SEQS="${BENCH_FLASH_SEQS:-512,1024,2048,4096}" \
+   BENCH_FLASH_BLOCKS="${BENCH_FLASH_BLOCKS:-128,256,512}" \
+       python bench.py --worker flash > FLASH_BF16.json.tmp; then
+    mv FLASH_BF16.json.tmp FLASH_BF16.json
+else
     echo "bf16 flash pass failed (non-fatal)" >&2
+    rm -f FLASH_BF16.json.tmp
+fi
 
 # pytest output goes to stderr so stdout stays ONE parseable JSON record
 # (probe_loop.sh captures stdout as $PROBE_MEASURED_OUT,

@@ -2,7 +2,8 @@ from .bert import (BertConfig, BertEncoder, BertForSequenceClassification,
                    bert_finetune_loss, glue_loss_fn)
 from .llama import (LlamaConfig, LlamaModel, causal_lm_loss_fn, lora_mask,
                     lora_optimizer)
-from .pretrained import (CheckpointMismatch, import_hf_bert, import_hf_llama,
+from .pretrained import (CheckpointMismatch, cast_float_leaves,
+                         import_hf_bert, import_hf_llama,
                          import_keras_inception, import_keras_resnet,
                          import_keras_vgg, import_keras_xception,
                          load_pretrained, merge_into_template, read_keras_h5)
@@ -24,5 +25,5 @@ __all__ = [
     "import_keras_resnet", "import_keras_vgg", "import_keras_inception",
     "import_keras_xception",
     "read_keras_h5", "merge_into_template", "CheckpointMismatch",
-    "ByteBPETokenizer",
+    "ByteBPETokenizer", "cast_float_leaves",
 ]

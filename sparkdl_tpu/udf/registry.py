@@ -89,7 +89,8 @@ def registerGenerationUDF(name: str, model, variables,
                           temperature: float = 0.0, seed: int = 0,
                           batchRows: int = 64, top_k: int = 0,
                           top_p: float = 1.0,
-                          eos_id: int | None = None) -> None:
+                          eos_id: int | None = None,
+                          params_dtype: str | None = None) -> None:
     """Register a text-generation UDF over token-id columns — the
     ``registerUDF`` batch-inference half of BASELINE config 5 ("Llama LoRA
     fine-tune via XlaRunner + registerUDF batch inference").
@@ -103,18 +104,29 @@ def registerGenerationUDF(name: str, model, variables,
     column doesn't build one giant cache; a short trailing chunk fills
     with duplicate rows (dropped from the output) so every chunk reuses
     the same two programs.
+
+    ``params_dtype="bfloat16"`` casts the float weights to the serving
+    dtype up front (``models.pretrained.cast_float_leaves``): decode is
+    weight-HBM-bandwidth-bound, so halving the stored weight bytes is a
+    direct decode-rate/footprint lever — numerically identical for
+    bf16-compute modules (flax casts params at use anyway); f32-compute
+    modules (norm scales, logits head) see bf16-rounded weights, the
+    standard bf16-serving tradeoff. Default None keeps the caller's
+    weights bit-exact.
     """
     _UDF_REGISTRY[name] = _make_generation_apply(
         model, variables, max_new_tokens=max_new_tokens,
         temperature=temperature, seed=seed, batchRows=batchRows,
-        top_k=top_k, top_p=top_p, eos_id=eos_id)
+        top_k=top_k, top_p=top_p, eos_id=eos_id,
+        params_dtype=params_dtype)
 
 
 def _make_generation_apply(model, variables, *, max_new_tokens: int = 32,
                            temperature: float = 0.0, seed: int = 0,
                            batchRows: int = 64, top_k: int = 0,
                            top_p: float = 1.0,
-                           eos_id: int | None = None) -> Callable:
+                           eos_id: int | None = None,
+                           params_dtype: str | None = None) -> Callable:
     """Build (and validate) the apply closure behind
     :func:`registerGenerationUDF` — shared with
     :func:`registerTextGenerationUDF` so the padding/chunking/EOS
@@ -133,6 +145,9 @@ def _make_generation_apply(model, variables, *, max_new_tokens: int = 32,
                                or not isinstance(eos_id, (int, np.integer))):
         raise TypeError(f"eos_id must be an int token id or None, "
                         f"got {eos_id!r}")
+    if params_dtype:
+        from ..models.pretrained import cast_float_leaves
+        variables = cast_float_leaves(variables, params_dtype)
 
     def apply(df: DataFrame, inputCol: str, outputCol: str) -> DataFrame:
         import pyarrow as pa
@@ -296,7 +311,9 @@ def registerTextGenerationUDF(name: str, model, variables,
 
 def registerSequenceClassificationUDF(name: str, model, variables,
                                       batchRows: int = 64,
-                                      pad_id: int = 0) -> None:
+                                      pad_id: int = 0,
+                                      params_dtype: str | None = None
+                                      ) -> None:
     """Register an encoder-classifier UDF over token-id columns — the
     serving half of BASELINE config 4 (BERT fine-tune), mirroring the
     generation UDF's streamed data plane for the encoder family.
@@ -314,6 +331,11 @@ def registerSequenceClassificationUDF(name: str, model, variables,
     import jax
     import jax.numpy as jnp
     import numpy as np
+
+    if params_dtype:
+        # serving-dtype weight cast — see registerGenerationUDF
+        from ..models.pretrained import cast_float_leaves
+        variables = cast_float_leaves(variables, params_dtype)
 
     @jax.jit
     def classify(ids, mask):
