@@ -671,23 +671,27 @@ def merge_into_template(imported: dict, template: dict) -> dict:
     return out
 
 
-def cast_float_leaves(variables, dtype="bfloat16"):
-    """Cast every floating-point leaf of a variables pytree to ``dtype``
-    — the serving-weights cast (industry-standard bf16 serving).
+def cast_float_leaves(variables, dtype="bfloat16", *, min_ndim: int = 2):
+    """Cast the MATRIX float leaves of a variables pytree to ``dtype`` —
+    the serving-weights cast (industry-standard bf16 serving).
 
     Models here are dtype-parameterized for COMPUTE (flax ``dtype=``) but
     store params in flax's default float32 ``param_dtype``; every
     ``apply`` then re-casts the f32 weights down before each matmul, so a
-    decode step's HBM traffic (and the resident footprint) is 2x what
-    the math needs. Pre-casting is numerically IDENTICAL for every
-    bf16-compute module — flax casts params to the compute dtype at use,
-    so they see the same bf16 values either way — while halving weight
-    HBM residency and the per-dispatch cast traffic. Modules that
-    compute in f32 on purpose (RMSNorm scales, the f32 logits head) see
-    bf16-ROUNDED weights instead of f32 ones: the standard bf16-serving
-    tradeoff, measured benign at model scale, but use the original tree
-    wherever bit-exact f32 parity matters (training state, equivalence
-    tests).
+    decode step's HBM traffic (and the resident footprint) is ~2x what
+    the math needs. The cast is scoped to leaves with ``ndim >=
+    min_ndim`` (default 2: conv/dense/embedding kernels — virtually all
+    the bytes) because those are exactly the leaves flax's
+    ``promote_dtype`` casts to the compute dtype at use anyway — for
+    them, pre-casting is numerically IDENTICAL. 1-D leaves stay f32 on
+    purpose: flax 0.12 BatchNorm/LayerNorm/RMSNorm do NOT cast their
+    stats/scale/bias before the f32 normalization math, so casting them
+    would silently shift bf16-mode outputs (and ``var + eps`` loses the
+    epsilon in bf16). The one approximation that remains: a module that
+    intentionally matmuls in f32 against a >=2-D kernel (e.g. a f32
+    logits head) sees bf16-ROUNDED weights — the standard bf16-serving
+    tradeoff; use the original tree wherever bit-exact f32 parity
+    matters (training state, equivalence tests).
 
     Integer leaves (token ids, step counters) pass through untouched.
     """
@@ -698,7 +702,7 @@ def cast_float_leaves(variables, dtype="bfloat16"):
 
     def cast(x):
         if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating) \
-                and x.dtype != dt:
+                and x.dtype != dt and getattr(x, "ndim", 0) >= min_ndim:
             return x.astype(dt)
         return x
 

@@ -111,11 +111,23 @@ class _NamedImageTransformer(XlaImageTransformer, HasSeed):
         return self
 
     def _make_fn(self):
+        import jax.numpy as jnp
         m = self._model()
         variables = self._load_variables()
+        dt = self._compute_dtype()
+        if dt != jnp.float32:
+            # Serve the conv/dense KERNELS in the compute dtype (a local
+            # copy — self._variables stays f32 for setWeights/save
+            # fidelity): numerically identical, since those are exactly
+            # the leaves flax promote_dtype casts at use; BN stats/
+            # scale/bias (1-D) stay f32 because flax BatchNorm runs its
+            # normalization math in f32 WITHOUT casting them — see
+            # cast_float_leaves. Halves weight HBM residency and drops
+            # the per-dispatch kernel cast from every program call.
+            from ..models.pretrained import cast_float_leaves
+            variables = cast_float_leaves(variables, dt)
         apply = m.apply_fn(features_only=self._features_only,
-                           dtype=self._compute_dtype(),
-                           **self._build_kwargs())
+                           dtype=dt, **self._build_kwargs())
         return lambda batch: apply(variables, batch)
 
     def _runner_key(self) -> tuple:

@@ -105,14 +105,14 @@ def registerGenerationUDF(name: str, model, variables,
     with duplicate rows (dropped from the output) so every chunk reuses
     the same two programs.
 
-    ``params_dtype="bfloat16"`` casts the float weights to the serving
+    ``params_dtype="bfloat16"`` casts the weight MATRICES to the serving
     dtype up front (``models.pretrained.cast_float_leaves``): decode is
     weight-HBM-bandwidth-bound, so halving the stored weight bytes is a
-    direct decode-rate/footprint lever — numerically identical for
-    bf16-compute modules (flax casts params at use anyway); f32-compute
-    modules (norm scales, logits head) see bf16-rounded weights, the
-    standard bf16-serving tradeoff. Default None keeps the caller's
-    weights bit-exact.
+    direct decode-rate/footprint lever — numerically identical for the
+    dense/embedding kernels (flax casts them at use anyway; 1-D norm
+    scales stay f32 untouched); only the intentionally-f32 logits head
+    sees bf16-rounded weights, the standard bf16-serving tradeoff.
+    Default None keeps the caller's weights bit-exact.
     """
     _UDF_REGISTRY[name] = _make_generation_apply(
         model, variables, max_new_tokens=max_new_tokens,

@@ -1048,20 +1048,26 @@ def test_generation_eos_early_exit_stops_decode_steps():
 
 
 def test_cast_float_leaves_mechanics():
-    """Float leaves cast to the serving dtype, integer leaves pass
-    through untouched, and the cast is idempotent."""
+    """Matrix float leaves cast to the serving dtype; 1-D float leaves
+    (BN stats / norm scales / biases — flax does NOT cast those at use)
+    and integer leaves pass through untouched; the cast is idempotent."""
     from sparkdl_tpu.models import cast_float_leaves
 
     tree = {"w": np.ones((4, 4), np.float32),
             "ids": np.arange(3, dtype=np.int32),
-            "nested": {"b": np.zeros(4, np.float64)}}
+            "nested": {"bn_scale": np.zeros(4, np.float64)}}
     out = cast_float_leaves(tree, "bfloat16")
     assert str(out["w"].dtype) == "bfloat16"
-    assert str(out["nested"]["b"].dtype) == "bfloat16"
+    # 1-D leaf untouched: flax BatchNorm/RMSNorm normalize in f32
+    # without casting stats/scale — pre-casting them would shift outputs
+    assert out["nested"]["bn_scale"].dtype == np.float64
     assert out["ids"].dtype == np.int32
     np.testing.assert_array_equal(np.asarray(out["ids"]), tree["ids"])
     again = cast_float_leaves(out, "bfloat16")
     assert str(again["w"].dtype) == "bfloat16"
+    # opt-in full cast still available
+    full = cast_float_leaves(tree, "bfloat16", min_ndim=0)
+    assert str(full["nested"]["bn_scale"].dtype) == "bfloat16"
 
 
 def test_generation_udf_serving_params_dtype():
