@@ -100,14 +100,23 @@ def _ring_shard(q, k, v, *, axis_name: str, causal: bool):
 
 
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
-                   causal: bool = False):
+                   causal: bool = False, batch_axis: str | None = None,
+                   head_axis: str | None = None):
     """Sequence-parallel attention over mesh axis ``axis``.
 
     Inputs [B, H, S, D] sharded (or shardable) on S over ``axis``; output has
     the same layout. Jit-safe; compose inside larger jitted programs.
+
+    ``batch_axis``/``head_axis`` name mesh axes the batch/head dims are
+    ALREADY sharded over — the DP×TP×SP composition on one 3-D mesh
+    (batch rows on the data axis, Megatron head-sharded activations on
+    the model axis). The ring body is independent across B and H, so
+    these are pure layout declarations: without them shard_map's specs
+    would demand replication over those axes and GSPMD would insert
+    all-gathers that undo the DP/TP sharding around every attention.
     """
     body = functools.partial(_ring_shard, axis_name=axis, causal=causal)
-    spec = P(None, None, axis, None)
+    spec = P(batch_axis, head_axis, axis, None)
     return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)
 

@@ -296,3 +296,25 @@ def test_ulysses_flash_local_attention():
         local_attn=functools.partial(flash_attention,
                                      block_q=16, block_k=16))
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_attention_composes_with_dp_tp_axes():
+    """ring_attention(batch_axis=, head_axis=) on a 3-D data×model×sp
+    mesh: B and H ride their already-sharded axes (no all-gather undoing
+    DP/TP around attention) and the result still matches single-device
+    dense attention — composition is layout, not math."""
+    mesh = runtime.make_mesh({"data": 2, "model": 2, "sp": 2})
+    rng = np.random.RandomState(9)
+    q, k, v = [jnp.asarray(rng.randn(4, 4, 32, 16).astype(np.float32) * 0.3)
+               for _ in range(3)]
+    ref = dense_attention(q, k, v, causal=True)
+    composed = jax.jit(lambda a, b, c: ring_attention(
+        a, b, c, mesh, axis="sp", causal=True,
+        batch_axis="data", head_axis="model"))
+    np.testing.assert_allclose(np.asarray(composed(q, k, v)),
+                               np.asarray(ref), atol=2e-5)
+    # sharded inputs (the composed-training layout) give the same answer
+    spec = jax.sharding.NamedSharding(mesh, P("data", "model", "sp", None))
+    qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+    np.testing.assert_allclose(np.asarray(composed(qs, ks, vs)),
+                               np.asarray(ref), atol=2e-5)
