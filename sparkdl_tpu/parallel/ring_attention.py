@@ -141,10 +141,13 @@ def _ulysses_shard(q, k, v, *, axis_name: str, causal: bool, local_attn):
 
 
 def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp",
-                      causal: bool = False, local_attn=None):
+                      causal: bool = False, local_attn=None,
+                      batch_axis: str | None = None,
+                      head_axis: str | None = None):
     """Ulysses-style sequence parallelism: all_to_all head-scatter /
     seq-gather, attention on local heads over the FULL sequence, inverse
-    all_to_all. Requires num_heads % axis_size == 0.
+    all_to_all. Requires num_heads % axis_size == 0 (per-TP-shard heads
+    when ``head_axis`` is set).
 
     ``local_attn``: the per-shard attention over [B, H/n, S, D]. Default
     ``None`` → dense (materializes an [S, S] score block per local head).
@@ -152,16 +155,28 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp",
     elsewhere) to keep the local compute streaming — at long S this is
     where the memory goes, so the flash kernel composes with the
     all-to-all layout exactly as SURVEY §5.7 prescribes.
+
+    ``batch_axis``/``head_axis`` compose with DP / Megatron TP on one
+    mesh exactly as in :func:`ring_attention`: B is independent
+    throughout, and with ``head_axis`` the all_to_all simply scatters
+    the TP-LOCAL head set over ``axis`` (the DeepSpeed Ulysses+TP
+    layout) — so the divisibility requirement becomes
+    (num_heads / tp) % axis_size == 0.
     """
     n = mesh.shape[axis]
-    if q.shape[1] % n:
+    tp = mesh.shape[head_axis] if head_axis else 1
+    if q.shape[1] % tp:
         raise ValueError(
-            f"num_heads={q.shape[1]} not divisible by {axis}={n}")
+            f"num_heads={q.shape[1]} not divisible by {head_axis}={tp}")
+    local_h = q.shape[1] // tp
+    if local_h % n:
+        raise ValueError(
+            f"per-shard num_heads={local_h} not divisible by {axis}={n}")
     if local_attn == "auto":
         from ..ops.flash_attention import resolve_attn_fn
         local_attn = resolve_attn_fn("auto")
     body = functools.partial(_ulysses_shard, axis_name=axis, causal=causal,
                              local_attn=local_attn or dense_attention)
-    spec = P(None, None, axis, None)
+    spec = P(batch_axis, head_axis, axis, None)
     return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)

@@ -85,7 +85,8 @@ def make_train_step(loss_fn: Callable, mesh: Mesh, data_axis: str = "data",
                     param_rules: Callable | None = None,
                     donate: bool = True, mutable: bool = False,
                     with_rng: bool = False, rng_seed: int = 0,
-                    remat: bool = False, accum_steps: int = 1) -> Callable:
+                    remat: bool = False, accum_steps: int = 1,
+                    batch_spec: P | None = None) -> Callable:
     """Compile an SPMD train step: ``step(state, batch) -> (state, metrics)``.
 
     ``loss_fn(params, apply_fn, batch) -> (loss, aux_dict)``; with
@@ -226,7 +227,12 @@ def make_train_step(loss_fn: Callable, mesh: Mesh, data_axis: str = "data",
         metrics = dict(loss=loss, **aux)
         return new_state, metrics
 
-    batch_sharding = NamedSharding(mesh, P(data_axis))
+    # ``batch_spec`` overrides the default rows-over-data_axis layout —
+    # e.g. P("data", "sp") pins SEQUENCE sharding through the step entry
+    # for the DP×TP×SP composition, so the constraint doesn't silently
+    # replicate the seq dim that ring attention then re-shards.
+    batch_sharding = NamedSharding(
+        mesh, batch_spec if batch_spec is not None else P(data_axis))
     # state sharding resolved lazily at first call (needs the concrete state
     # treedef); jax.jit handles that via in_shardings=None for the state and
     # explicit constraint on the batch.

@@ -318,3 +318,28 @@ def test_ring_attention_composes_with_dp_tp_axes():
     qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
     np.testing.assert_allclose(np.asarray(composed(qs, ks, vs)),
                                np.asarray(ref), atol=2e-5)
+
+
+def test_ulysses_composes_with_dp_tp_axes():
+    """Ulysses on the 3-D data×model×sp mesh (the DeepSpeed Ulysses+TP
+    layout): the all_to_all scatters the TP-local head set over sp, B
+    rides the data axis, and the answer matches single-device dense."""
+    mesh = runtime.make_mesh({"data": 2, "model": 2, "sp": 2})
+    rng = np.random.RandomState(11)
+    q, k, v = [jnp.asarray(rng.randn(4, 4, 32, 16).astype(np.float32) * 0.3)
+               for _ in range(3)]
+    ref = dense_attention(q, k, v, causal=True)
+    composed = jax.jit(lambda a, b, c: ulysses_attention(
+        a, b, c, mesh, axis="sp", causal=True,
+        batch_axis="data", head_axis="model"))
+    np.testing.assert_allclose(np.asarray(composed(q, k, v)),
+                               np.asarray(ref), atol=2e-5)
+    spec = jax.sharding.NamedSharding(mesh, P("data", "model", "sp", None))
+    qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+    np.testing.assert_allclose(np.asarray(composed(qs, ks, vs)),
+                               np.asarray(ref), atol=2e-5)
+    # per-TP-shard divisibility is the enforced contract: 4 heads / tp 2
+    # = 2 local heads over sp 2 is exactly divisible; 1 local head is not
+    with pytest.raises(ValueError, match="not divisible"):
+        ulysses_attention(q[:, :2], k[:, :2], v[:, :2], mesh, axis="sp",
+                          batch_axis="data", head_axis="model")
