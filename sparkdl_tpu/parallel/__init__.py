@@ -8,12 +8,12 @@ from .pipeline import (gpipe, microbatch, stack_stage_params,
                        stage_sharding)
 from .ring_attention import (dense_attention, ring_attention,
                              ulysses_attention)
-from .sharding import (describe, lora_rules, make_rules, shard_params,
-                       sharding_pytree, transformer_tp_rules)
+from .sharding import (describe, fsdp_rules, lora_rules, make_rules,
+                       shard_params, sharding_pytree, transformer_tp_rules)
 
 __all__ = [
     "make_rules", "shard_params", "sharding_pytree", "describe",
-    "transformer_tp_rules", "lora_rules",
+    "transformer_tp_rules", "lora_rules", "fsdp_rules",
     "ring_attention", "ulysses_attention", "dense_attention",
     "gpipe", "microbatch", "stack_stage_params", "stage_sharding",
     "SwitchMoE", "moe_rules", "moe_aux_loss",

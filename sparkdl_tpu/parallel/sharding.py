@@ -102,13 +102,14 @@ def transformer_tp_rules(model_axis: str = "model",
       vocab-sharded.
     - everything else (norms, biases): replicated.
 
-    With ``data_axis`` set, 2-D FSDP-style layouts can extend these rules;
-    the baseline configs need only 1-D TP + DP batch sharding.
+    With ``data_axis`` set, the TP rules are extended to the 2-D
+    FSDP×TP layout via :func:`fsdp_rules` (each kernel's first
+    TP-unsharded dim additionally shards over the data axis).
     """
     m = model_axis
     # (/base)? skips the LoRADense wrapper segment (models/llama.py): the
     # frozen kernel lives at e.g. 'q_proj/base/kernel'.
-    return make_rules([
+    rules = make_rules([
         (r"(q_proj|k_proj|v_proj|query|key|value)(/base)?/kernel",
          P(None, m)),
         (r"(o_proj|out_proj|attention_output)(/base)?/kernel", P(m, None)),
@@ -118,6 +119,44 @@ def transformer_tp_rules(model_axis: str = "model",
         (r"(embed_tokens|embedding|lm_head|word_embeddings)/(embedding|kernel)",
          P(None, m)),
     ])
+    return fsdp_rules(rules, data_axis) if data_axis else rules
+
+
+def fsdp_rules(base_rules: Callable | None = None,
+               data_axis: str = "data") -> Callable:
+    """ZeRO-3 / FSDP-style parameter sharding, GSPMD-idiomatic: every
+    >=2-D kernel additionally shards its first base-unsharded dim over
+    the DATA axis, so per-chip param (and optimizer-state) residency
+    drops by the data-axis size. XLA inserts the all-gather before each
+    use and the corresponding reduce-scatter on the gradients — the
+    weight-stationary FSDP schedule falls out of the layout, no wrapper
+    class or hook. Composes with Megatron TP by passing
+    ``transformer_tp_rules()`` as ``base_rules`` (or just use
+    ``transformer_tp_rules(data_axis=...)``); 1-D leaves (norm scales,
+    biases) stay on the base layout — sharding them saves nothing and
+    costs a gather per use."""
+    def rules(path, leaf) -> P:
+        base = base_rules(path, leaf) if base_rules is not None else P()
+        ndim = getattr(leaf, "ndim", 0)
+        # idempotent: a base spec already carrying data_axis (e.g.
+        # fsdp_rules(transformer_tp_rules(data_axis=...))) must not gain
+        # a duplicate mesh axis
+        if ndim < 2 or data_axis in base:
+            return base
+        spec = list(base) + [None] * (ndim - len(base))
+        for i, s in enumerate(spec):
+            if s is None:
+                spec[i] = data_axis
+                break
+        return P(*spec)
+
+    # forward the base TP matcher: lora_rules derives adapter specs from
+    # the BASE kernel's TP dims through this attribute — adapters inherit
+    # the TP layout and deliberately stay UNsharded on the data axis
+    # (rank-r dims are tiny; FSDP-sharding them costs a gather per use
+    # and saves nothing)
+    rules.match_str = getattr(base_rules, "match_str", None)
+    return rules
 
 
 def lora_rules(base_rules: Callable, model_axis: str = "model") -> Callable:

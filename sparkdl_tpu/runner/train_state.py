@@ -184,8 +184,15 @@ def make_train_step(loss_fn: Callable, mesh: Mesh, data_axis: str = "data",
                     x = jnp.moveaxis(x, 1, 0)
                     x = x.reshape((accum_steps, n_shard * local)
                                   + x.shape[3:])
+                    # microbatch layout = leading accum dim + the step's
+                    # batch spec (rank-truncated per leaf): a batch_spec
+                    # pinning seq-over-sp must survive the split, not be
+                    # re-replicated here
+                    eff = batch_spec if batch_spec is not None \
+                        else P(data_axis)
                     return jax.lax.with_sharding_constraint(
-                        x, NamedSharding(mesh, P(None, data_axis)))
+                        x, NamedSharding(
+                            mesh, P(None, *tuple(eff)[:x.ndim - 1])))
                 # Not enough rows per chip for the aligned split —
                 # contiguous reshape; GSPMD may reshard across chips.
                 return x.reshape((accum_steps, -1) + x.shape[1:])
@@ -230,14 +237,21 @@ def make_train_step(loss_fn: Callable, mesh: Mesh, data_axis: str = "data",
     # ``batch_spec`` overrides the default rows-over-data_axis layout —
     # e.g. P("data", "sp") pins SEQUENCE sharding through the step entry
     # for the DP×TP×SP composition, so the constraint doesn't silently
-    # replicate the seq dim that ring attention then re-shards.
-    batch_sharding = NamedSharding(
-        mesh, batch_spec if batch_spec is not None else P(data_axis))
+    # replicate the seq dim that ring attention then re-shards. Applied
+    # per leaf with the spec truncated to the leaf's rank (a [B] label
+    # leaf under P("data", "sp") constrains as P("data")), matching
+    # make_rules' truncation convention.
+    entry_spec = batch_spec if batch_spec is not None else P(data_axis)
+
+    def _constrain(x):
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(
+                mesh, P(*tuple(entry_spec)[:getattr(x, "ndim", 0)])))
     # state sharding resolved lazily at first call (needs the concrete state
     # treedef); jax.jit handles that via in_shardings=None for the state and
     # explicit constraint on the batch.
     def with_constraints(state, batch):
-        batch = jax.lax.with_sharding_constraint(batch, batch_sharding)
+        batch = jax.tree_util.tree_map(_constrain, batch)
         return step(state, batch)
 
     return jax.jit(with_constraints, donate_argnums=(0,) if donate else ())
