@@ -1157,6 +1157,7 @@ class _Budget:
     def __init__(self, wall_s: float):
         self.wall_s = wall_s
         self.t0 = time.monotonic()
+        self.leg_times: dict = {}  # leg name -> wall seconds
 
     def remaining(self) -> float:
         return self.wall_s - (time.monotonic() - self.t0)
@@ -1168,9 +1169,19 @@ class _Budget:
 def _run_worker(name: str, timeout_s: float, retries: int,
                 budget: _Budget) -> tuple[dict | None, dict | None]:
     """Run one metric in a subprocess with timeout+retries, clamped to the
-    remaining wall budget.
+    remaining wall budget. Leg wall time lands on ``budget.leg_times``
+    (serialized under extra["budget"]["leg_times_s"]).
 
     Returns (result, error): exactly one is non-None."""
+    t_leg = time.monotonic()
+    try:
+        return _run_worker_inner(name, timeout_s, retries, budget)
+    finally:
+        budget.leg_times[name] = round(time.monotonic() - t_leg, 1)
+
+
+def _run_worker_inner(name: str, timeout_s: float, retries: int,
+                      budget: _Budget) -> tuple[dict | None, dict | None]:
     last_err: dict = {}
     for attempt in range(retries + 1):
         if attempt:
@@ -1257,7 +1268,8 @@ def main():
     else:
         err_extra = {"probe_error": probe_err,
                      "budget": {"wall_s": budget.wall_s,
-                                "spent_s": round(budget.spent(), 1)}}
+                                "spent_s": round(budget.spent(), 1),
+                                "leg_times_s": dict(budget.leg_times)}}
         # An outage at bench time must not erase the round's measured
         # evidence: embed the newest on-chip record + the probe history.
         pl = _probe_log_summary()
@@ -1370,7 +1382,10 @@ def main():
     # dispatch time and unusable.
     extra["timing_barrier"] = "host_fetch"
     extra["budget"] = {"wall_s": budget.wall_s,
-                       "spent_s": round(budget.spent(), 1)}
+                       "spent_s": round(budget.spent(), 1),
+                       # per-leg wall seconds: shows how the budget was
+                       # spent and which leg to trim if it ever overruns
+                       "leg_times_s": dict(budget.leg_times)}
     pl = _probe_log_summary()
     if pl:
         extra["probe_log"] = pl
