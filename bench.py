@@ -1049,25 +1049,14 @@ _WORKERS = {"resnet50_train": _worker_resnet50_train,
 
 def _classify_failure(text: str) -> str:
     """Retryable vs fatal, by the runner's failure taxonomy (works on the
-    child's stderr text so a dead child can still be classified)."""
+    child's stderr text so a dead child can still be classified). The
+    policy lives in failures.classify_text — one regex set shared with the
+    gang supervisor, so bench retries and supervise restarts can't drift."""
     try:
-        from sparkdl_tpu.runner.failures import (_FATAL_PATTERNS,
-                                                 _RETRYABLE_PATTERNS)
-        # Fatal first, matching failures.classify_exception: stderr spew
-        # often contains incidental CANCELLED/coordination lines during
-        # teardown of a run that actually died on a program error.
-        if _FATAL_PATTERNS.search(text):
-            return "fatal"
-        if _RETRYABLE_PATTERNS.search(text):
-            return "retryable"
+        from sparkdl_tpu.runner.failures import classify_text
+        return classify_text(text)
     except Exception:
-        pass
-    # Python-level tracebacks ending in user-code errors are fatal.
-    for fatal in ("ValueError", "TypeError", "KeyError", "AssertionError",
-                  "AttributeError", "ModuleNotFoundError", "ImportError"):
-        if f"{fatal}:" in text:
-            return "fatal"
-    return "retryable"
+        return "retryable"
 
 
 def _headline_config() -> dict:
@@ -1158,6 +1147,11 @@ class _Budget:
         self.wall_s = wall_s
         self.t0 = time.monotonic()
         self.leg_times: dict = {}  # leg name -> wall seconds
+        # Driver-level failure ledger (routed into the record next to the
+        # workers' own run_stats — ISSUE 1: the emitted JSON reports
+        # restarts / faults_injected / last_failure_kind).
+        self.restarts = 0
+        self.last_failure_kind: str | None = None
 
     def remaining(self) -> float:
         return self.wall_s - (time.monotonic() - self.t0)
@@ -1195,6 +1189,7 @@ def _run_worker_inner(name: str, timeout_s: float, retries: int,
             print(f"bench[{name}]: retry {attempt}/{retries} "
                   f"after {backoff:.0f}s", file=sys.stderr)
             time.sleep(backoff)
+            budget.restarts += 1
         # Leave ~30s of slack for the driver to assemble + print the record.
         attempt_timeout = min(timeout_s, budget.remaining() - 30)
         if attempt_timeout < min(timeout_s, 30):
@@ -1212,6 +1207,7 @@ def _run_worker_inner(name: str, timeout_s: float, retries: int,
             last_err = {"kind": "timeout",
                         "detail": f"worker exceeded {attempt_timeout:.0f}s "
                                   "(backend init hang?)"}
+            budget.last_failure_kind = "timeout"
             if attempt_timeout >= 300:
                 # A LONG timeout is a hang, not a transient blip:
                 # retrying would burn another long attempt and starve the
@@ -1235,6 +1231,7 @@ def _run_worker_inner(name: str, timeout_s: float, retries: int,
             kind = _classify_failure(tail)
             last_err = {"kind": kind, "rc": proc.returncode,
                         "detail": tail[-500:]}
+            budget.last_failure_kind = kind
             if kind == "fatal":
                 break  # a program bug won't fix itself on retry
     return None, last_err
@@ -1247,6 +1244,17 @@ def main():
         if hang:  # hardening-test knob: simulate the hung-backend outage
             time.sleep(hang)
         result = _WORKERS[sys.argv[2]]()
+        try:
+            # Worker-side failure/chaos ledger rides the result (only when
+            # something actually happened — the common all-zero snapshot
+            # would just be noise in every leg).
+            from sparkdl_tpu.runner.metrics import run_stats
+            snap = run_stats.snapshot()
+            if isinstance(result, dict) and (snap["restarts"] or
+                                             snap["faults_injected"]):
+                result.setdefault("failure_stats", snap)
+        except Exception:
+            pass
         print(json.dumps(result))
         return
 
@@ -1381,6 +1389,19 @@ def main():
     # right), but short amortized loops (the flash leg) were pure
     # dispatch time and unusable.
     extra["timing_barrier"] = "host_fetch"
+    # Failure/recovery ledger (ISSUE 1): driver-level retry restarts plus
+    # whatever the workers' run_stats recorded (chaos injections, in-worker
+    # run_with_restarts), so the record shows HOW the number was survived.
+    fs = {"restarts": budget.restarts, "faults_injected": 0,
+          "last_failure_kind": budget.last_failure_kind}
+    for r in (train, feat, flash, bert, gen, ns):
+        ws = (r or {}).get("failure_stats") if isinstance(r, dict) else None
+        if isinstance(ws, dict):
+            fs["restarts"] += int(ws.get("restarts") or 0)
+            fs["faults_injected"] += int(ws.get("faults_injected") or 0)
+            fs["last_failure_kind"] = (ws.get("last_failure_kind")
+                                       or fs["last_failure_kind"])
+    extra["failure_stats"] = fs
     extra["budget"] = {"wall_s": budget.wall_s,
                        "spent_s": round(budget.spent(), 1),
                        # per-leg wall seconds: shows how the budget was

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Chaos smoke: one injected preemption + checkpoint resume, end-to-end
+through the supervising launcher, on CPU (ISSUE 1 satellite).
+
+Flow: ``supervise()`` launches a single-rank training worker with a
+``FaultPlan`` that raises an UNAVAILABLE-shaped preemption at step 3 (env
+transport — the worker script has zero chaos awareness). Attempt 1
+checkpoints at step 2 and dies; the supervisor classifies the stderr
+retryable and relaunches; attempt 2 resumes from the checkpoint and runs
+only the remaining steps. Prints one JSON line and exits 0 on success.
+
+Run: ``JAX_PLATFORMS=cpu python scripts/chaos_smoke.py``
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# The supervisor never queries devices, so no jax backend is initialized
+# in this process — the workers own the chips.
+from sparkdl_tpu.runner.chaos import Fault, FaultPlan  # noqa: E402
+from sparkdl_tpu.runner.launcher import supervise  # noqa: E402
+
+_WORKER = """
+import json, os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, {repo!r})
+import numpy as np
+import optax
+from sparkdl_tpu.runner import XlaRunner, softmax_cross_entropy_loss
+
+out_dir = sys.argv[1]
+runner = XlaRunner(checkpoint_dir=os.path.join(out_dir, "ckpt"))
+rng = np.random.RandomState(0)
+params = {{"w": rng.randn(4, 3).astype(np.float32)}}
+
+def data():
+    r = np.random.RandomState(1)
+    while True:
+        yield {{"image": r.randn(8, 4).astype(np.float32),
+               "label": r.randint(0, 3, (8,))}}
+
+res = runner.run(lambda ctx: ctx.fit(
+    loss_fn=softmax_cross_entropy_loss(), params=params, tx=optax.sgd(0.1),
+    apply_fn=lambda p, x: x @ p["w"], data=data(), num_steps=6,
+    checkpoint_every=2, log_every=100))
+with open(os.path.join(out_dir, "attempts.jsonl"), "a") as f:
+    f.write(json.dumps({{"final_step": int(res["state"].step),
+                        "steps_this_attempt": res["meter"].steps}}) + "\\n")
+"""
+
+
+def main() -> int:
+    out_dir = tempfile.mkdtemp(prefix="sparkdl-chaos-smoke-")
+    worker = os.path.join(out_dir, "worker.py")
+    with open(worker, "w") as f:
+        f.write(_WORKER.format(repo=_REPO))
+
+    plan = FaultPlan([Fault("step_start", "preempt", at_step=3)])
+    res = supervise(worker, np=1, args=[out_dir], timeout_s=300.0,
+                    max_restarts=2, backoff_s=0.1, poll_s=0.25, plan=plan)
+
+    attempts_path = os.path.join(out_dir, "attempts.jsonl")
+    attempts = [json.loads(ln) for ln in open(attempts_path)]
+    # Only the surviving attempt writes: it must have finished at step 6
+    # having run just the 4 post-checkpoint steps (resume from step 2).
+    ok = (res.restarts == 1
+          and res.failure_kinds == ["retryable"]
+          and len(attempts) == 1
+          and attempts[0]["final_step"] == 6
+          and attempts[0]["steps_this_attempt"] == 4)
+    print(json.dumps({
+        "ok": ok,
+        "restarts": res.restarts,
+        "failure_kinds": res.failure_kinds,
+        "final_step": attempts[0]["final_step"] if attempts else None,
+        "steps_in_resumed_attempt":
+            attempts[0]["steps_this_attempt"] if attempts else None,
+        "resumed_from_step": 2,
+        "out_dir": out_dir,
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,11 +2,20 @@
 
 See ``xla_runner.py`` for the architecture note: collectives move *inside*
 the compiled step; process topology is SPMD-per-host, not mpirun-per-slot.
+Failure handling is a first-class subsystem: ``failures.py`` is the
+retryable/fatal policy point, ``launcher.supervise`` the budgeted
+checkpoint-restart gang supervisor, and ``chaos.py`` the deterministic
+fault injector that keeps every recovery path tested.
 """
 
+from .chaos import Fault, FaultPlan, InjectedFatal, InjectedFault, \
+    InjectedPreemption
 from .checkpoint import CheckpointManager, load_portable, save_portable
-from .failures import classify_exception, diagnose_context, is_retryable
-from .metrics import MetricsLogger, ThroughputMeter, debug_mode, trace
+from .failures import TrainingDivergedError, classify_exception, \
+    classify_text, diagnose_context, is_retryable
+from .launcher import GangFailure, SuperviseResult, launch, supervise
+from .metrics import MetricsLogger, ThroughputMeter, debug_mode, run_stats, \
+    touch_heartbeat, trace
 from .train_state import (TrainState, bn_classifier_loss, make_eval_step,
                           make_shard_map_step, make_train_step,
                           softmax_cross_entropy_loss, state_sharding)
@@ -22,6 +31,11 @@ __all__ = [
     "TrainState", "make_train_step", "make_shard_map_step", "make_eval_step",
     "state_sharding", "softmax_cross_entropy_loss", "bn_classifier_loss",
     "CheckpointManager", "save_portable", "load_portable",
-    "classify_exception", "is_retryable", "diagnose_context",
+    "classify_exception", "classify_text", "is_retryable",
+    "diagnose_context", "TrainingDivergedError",
+    "Fault", "FaultPlan", "InjectedFault", "InjectedPreemption",
+    "InjectedFatal",
+    "launch", "supervise", "GangFailure", "SuperviseResult",
     "ThroughputMeter", "MetricsLogger", "trace", "debug_mode",
+    "run_stats", "touch_heartbeat",
 ]

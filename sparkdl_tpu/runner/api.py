@@ -52,9 +52,17 @@ def local_rank() -> int:
 
 
 def shutdown():
+    """hvd.shutdown() — tear down the context established by :func:`init`.
+
+    Clears the cached default runner too: a second ``init()`` after
+    ``shutdown()`` must build a fresh runner/mesh, not resurrect the stale
+    one (regression: the cache used to outlive the context stack).
+    """
+    global _default_runner
     from . import xla_runner
     if xla_runner._CURRENT_CONTEXT:
         xla_runner._CURRENT_CONTEXT.pop()
+    _default_runner = None
 
 
 def allreduce(x, average: bool = True):
@@ -67,6 +75,8 @@ def allreduce(x, average: bool = True):
     own local value, exactly hvd.allreduce semantics. In-step gradient
     reduction should NOT use this; it is compiled into the train step
     (see train_state.py)."""
+    from . import chaos
+    chaos.fire("collective")
     ctx = _ctx()
     if jax.process_count() > 1:
         import numpy as np
@@ -94,6 +104,8 @@ def broadcast(x, root_rank: int = 0):
     replicated over the mesh. Multi-process: a real broadcast from process
     ``root_rank`` (non-zero roots first rotate the value to process 0 via
     allgather, since the underlying primitive is one-to-all from 0)."""
+    from . import chaos
+    chaos.fire("collective")
     ctx = _ctx()
     if jax.process_count() > 1:
         import numpy as np

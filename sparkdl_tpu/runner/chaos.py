@@ -1,0 +1,296 @@
+"""Deterministic fault injection — the chaos half of the failure story
+(SURVEY.md §5.3; ISSUE 1 tentpole).
+
+The reference's recovery machinery (Spark task retry, whole-Horovod-job
+failure) was never *testable*: you waited for a real chip to die. Here every
+recovery path in the runner is exercisable on demand: a seeded
+:class:`FaultPlan` injects faults at named **sites** inside the training
+machinery, and because plans serialize to a single env var
+(``SPARKDL_CHAOS``), ``launcher.launch``/``launcher.supervise`` workers pick
+them up with **zero changes to user scripts** — the supervisor's restart,
+watchdog, and classification paths run under injected preemption, crash,
+hang, NaN, and SIGKILL in tier-1 tests instead of "written but never
+executed".
+
+Sites (where the runner consults the plan):
+
+- ``step_start``       — top of ``RunnerContext.fit``'s step loop
+- ``batch_fetch``      — after a host batch is drawn (``nan`` poisons it)
+- ``checkpoint_save``  — inside ``CheckpointManager.save``
+- ``collective``       — entry of the hvd-compat ``allreduce``/``broadcast``
+- ``worker``           — entry of ``XlaRunner.run`` (worker program start)
+
+Kinds (what happens when a fault fires):
+
+- ``preempt`` — raise a retryable ``UNAVAILABLE``/preemption-shaped error
+  (the XlaRuntimeError text the classifier maps to checkpoint-and-restart)
+- ``fatal``   — raise an ``INVALID_ARGUMENT``-shaped program error (no retry)
+- ``nan``     — poison the batch's float leaves with NaN (``batch_fetch``
+  only; exercises the train loop's divergence guard)
+- ``hang``    — sleep ``hang_s`` (exercises the heartbeat watchdog)
+- ``sigkill`` — ``SIGKILL`` the calling process (multi-process gang tests)
+
+Triggers are deterministic: ``at_step=N`` fires when the hook's step equals
+N; ``prob=p`` draws from a per-fault ``RandomState`` seeded from
+``(plan.seed, fault index)`` so two identically-seeded plans fire
+identically. ``once=True`` (default) fires at most once — and when the plan
+carries a ``state_dir``, "once" persists across process restarts via marker
+files, so a relaunched gang does not re-inject the same preemption forever
+(``supervise`` provides a state dir automatically).
+
+This module keeps its import surface stdlib+numpy-light so the supervising
+launcher can import it without dragging in jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import sys
+import time
+
+__all__ = ["Fault", "FaultPlan", "InjectedFault", "InjectedPreemption",
+           "InjectedFatal", "SITES", "KINDS", "CHAOS_ENV",
+           "fire", "install", "uninstall", "active_plan"]
+
+CHAOS_ENV = "SPARKDL_CHAOS"
+
+SITES = ("step_start", "checkpoint_save", "batch_fetch", "collective",
+         "worker")
+KINDS = ("preempt", "fatal", "nan", "hang", "sigkill")
+
+
+class InjectedFault(RuntimeError):
+    """Base of all chaos-raised errors (lets tests/telemetry tell injected
+    failures from organic ones; classification ignores this and goes by
+    message text, exactly as it would for the real error)."""
+
+
+class InjectedPreemption(InjectedFault):
+    """Retryable: shaped like the XlaRuntimeError a preempted slice or a
+    dropped coordination-service connection produces."""
+
+
+class InjectedFatal(InjectedFault):
+    """Fatal: shaped like an INVALID_ARGUMENT program error."""
+
+
+def _this_rank() -> int:
+    return int(os.environ.get("SPARKDL_PROCESS_ID", "0"))
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One injection: fire ``kind`` at ``site`` when the trigger matches.
+
+    Exactly one trigger: ``at_step`` (fire when the hook's step == N; for
+    stepless sites like ``worker``/``collective`` use ``at_step=None``
+    with ``prob=1.0``) or ``prob`` (seeded coin per eligible call).
+    ``rank`` restricts to one process (``SPARKDL_PROCESS_ID``); ``once``
+    caps total fires at one (per process, or globally with a plan
+    ``state_dir``).
+    """
+    site: str
+    kind: str
+    at_step: int | None = None
+    prob: float = 0.0
+    rank: int | None = None
+    once: bool = True
+    hang_s: float = 3600.0
+
+    def __post_init__(self):
+        if self.site not in SITES:
+            raise ValueError(f"unknown chaos site {self.site!r}; "
+                             f"sites: {SITES}")
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown chaos kind {self.kind!r}; "
+                             f"kinds: {KINDS}")
+        if self.kind == "nan" and self.site != "batch_fetch":
+            raise ValueError("kind='nan' only poisons batches — use "
+                             "site='batch_fetch'")
+        if self.at_step is None and not (0.0 < self.prob <= 1.0):
+            raise ValueError(f"fault needs a trigger: at_step=N or "
+                             f"0 < prob <= 1 (got at_step=None, "
+                             f"prob={self.prob})")
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """A seeded set of :class:`Fault`\\ s plus the firing state machine.
+
+    ``state_dir``: when set, ``once`` faults leave a marker file there on
+    firing, making "once" hold across process restarts (the supervisor's
+    relaunch must not re-trip the same injected preemption every attempt).
+    """
+    faults: list[Fault]
+    seed: int = 0
+    state_dir: str | None = None
+
+    def __post_init__(self):
+        self.faults = [f if isinstance(f, Fault) else Fault(**f)
+                       for f in self.faults]
+        self._fired = [0] * len(self.faults)
+        self._rngs = None  # built lazily; numpy not needed for serialization
+
+    # -- serialization (env-var transport to launched workers) -----------
+    def to_json(self) -> str:
+        return json.dumps({
+            "seed": self.seed, "state_dir": self.state_dir,
+            "faults": [dataclasses.asdict(f) for f in self.faults]})
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        d = json.loads(text)
+        return cls(faults=[Fault(**f) for f in d.get("faults", [])],
+                   seed=int(d.get("seed", 0)),
+                   state_dir=d.get("state_dir"))
+
+    def to_env(self) -> dict[str, str]:
+        """Env fragment for launcher workers: merge into the child env and
+        the worker's first ``fire()`` installs the plan — no user-script
+        changes."""
+        return {CHAOS_ENV: self.to_json()}
+
+    @classmethod
+    def from_env(cls, environ=None) -> "FaultPlan | None":
+        text = (environ if environ is not None else os.environ).get(CHAOS_ENV)
+        return cls.from_json(text) if text else None
+
+    # -- firing -----------------------------------------------------------
+    def _rng(self, idx: int):
+        if self._rngs is None:
+            self._rngs = {}
+        if idx not in self._rngs:
+            import numpy as np
+            self._rngs[idx] = np.random.RandomState(
+                (self.seed * 1000003 + idx) % (2 ** 32))
+        return self._rngs[idx]
+
+    def _marker(self, idx: int) -> str | None:
+        if not self.state_dir:
+            return None
+        return os.path.join(self.state_dir, f"chaos_fault{idx}.fired")
+
+    def _already_fired(self, idx: int) -> bool:
+        if self._fired[idx]:
+            return True
+        marker = self._marker(idx)
+        return bool(marker and os.path.exists(marker))
+
+    def _mark_fired(self, idx: int):
+        self._fired[idx] += 1
+        marker = self._marker(idx)
+        if marker:
+            try:
+                os.makedirs(self.state_dir, exist_ok=True)
+                with open(marker, "w") as f:
+                    f.write(str(time.time()))
+            except OSError:
+                pass  # losing the marker degrades to per-process "once"
+
+    def fire(self, site: str, step: int | None = None, batch=None):
+        """Consult the plan at ``site``; returns ``batch`` (possibly
+        poisoned). Raising kinds raise; ``sigkill`` does not return."""
+        out = batch
+        for idx, f in enumerate(self.faults):
+            if f.site != site:
+                continue
+            if f.rank is not None and f.rank != _this_rank():
+                continue
+            if f.once and self._already_fired(idx):
+                continue
+            if f.at_step is not None:
+                if step is None or int(step) != f.at_step:
+                    continue
+            elif self._rng(idx).random_sample() >= f.prob:
+                continue
+            self._mark_fired(idx)
+            _record_fault(site, f.kind)
+            out = _execute(f, site, step, out)
+        return out
+
+
+def _record_fault(site: str, kind: str):
+    """Count into metrics.run_stats (lazy: metrics imports jax; the
+    supervisor process importing chaos must stay jax-free)."""
+    try:
+        from . import metrics as metrics_lib
+        metrics_lib.run_stats.record_fault(site, kind)
+    except Exception:
+        pass
+
+
+def _execute(f: Fault, site: str, step, batch):
+    where = f"chaos site={site}" + (f" step={step}" if step is not None
+                                    else "")
+    if f.kind == "preempt":
+        raise InjectedPreemption(
+            f"UNAVAILABLE: injected preemption ({where}): slice is "
+            "unhealthy, coordination service heartbeat lost")
+    if f.kind == "fatal":
+        raise InjectedFatal(
+            f"INVALID_ARGUMENT: injected program error ({where})")
+    if f.kind == "nan":
+        return _poison(batch)
+    if f.kind == "hang":
+        time.sleep(f.hang_s)
+        return batch
+    if f.kind == "sigkill":
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os.kill(os.getpid(), signal.SIGKILL)
+    return batch
+
+
+def _poison(batch):
+    """NaN every float leaf of a host-numpy pytree (dict/list/tuple/array);
+    integer leaves (labels, ids) pass through untouched."""
+    import numpy as np
+    if batch is None:
+        return None
+    if isinstance(batch, dict):
+        return {k: _poison(v) for k, v in batch.items()}
+    if isinstance(batch, (list, tuple)):
+        return type(batch)(_poison(v) for v in batch)
+    arr = np.asarray(batch)
+    if np.issubdtype(arr.dtype, np.floating):
+        return np.full_like(arr, np.nan)
+    return batch
+
+
+# -- process-global active plan ---------------------------------------------
+# Hooks call the module-level fire(); the plan comes from an explicit
+# install() (in-process tests) or, lazily on first fire, from SPARKDL_CHAOS
+# (launcher workers). No plan anywhere = every hook is a cheap no-op.
+
+_ACTIVE: FaultPlan | None = None
+_ENV_CHECKED = False
+
+
+def install(plan: FaultPlan) -> FaultPlan:
+    global _ACTIVE, _ENV_CHECKED
+    _ACTIVE, _ENV_CHECKED = plan, True
+    return plan
+
+
+def uninstall():
+    global _ACTIVE, _ENV_CHECKED
+    _ACTIVE, _ENV_CHECKED = None, False
+
+
+def active_plan() -> FaultPlan | None:
+    global _ACTIVE, _ENV_CHECKED
+    if _ACTIVE is None and not _ENV_CHECKED:
+        _ENV_CHECKED = True
+        _ACTIVE = FaultPlan.from_env()
+    return _ACTIVE
+
+
+def fire(site: str, step: int | None = None, batch=None):
+    """The hook the runner calls at each site; no-op without a plan."""
+    plan = active_plan()
+    if plan is None:
+        return batch
+    return plan.fire(site, step=step, batch=batch)

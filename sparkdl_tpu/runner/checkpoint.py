@@ -40,6 +40,9 @@ class CheckpointManager:
 
     def save(self, step: int, state: Any, wait: bool = False):
         import orbax.checkpoint as ocp
+
+        from . import chaos
+        chaos.fire("checkpoint_save", step=step)
         payload = {
             "params": state.params,
             "opt_state": state.opt_state,

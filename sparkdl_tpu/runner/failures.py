@@ -40,6 +40,21 @@ _FATAL_TYPES = (TypeError, ValueError, KeyError, IndexError, AttributeError,
                 AssertionError, ZeroDivisionError, NotImplementedError)
 
 
+class TrainingDivergedError(RuntimeError):
+    """Fatal: the train loop produced a non-finite loss (ISSUE 1 tentpole).
+
+    Raised by ``RunnerContext.fit``'s divergence guard instead of silently
+    checkpointing garbage — restarting from the same data/params would
+    diverge again, so retrying burns the restart budget for nothing.
+    """
+
+    def __init__(self, step: int, value: float | None = None):
+        super().__init__(
+            f"training diverged: non-finite loss ({value}) at step {step}")
+        self.step = step
+        self.value = value
+
+
 def classify_exception(exc: BaseException) -> str:
     """Return ``"retryable"`` or ``"fatal"`` for a training-run exception.
 
@@ -51,6 +66,8 @@ def classify_exception(exc: BaseException) -> str:
     makes a wasted restart cheap, while a missed restart loses the job.
     """
     if isinstance(exc, KeyboardInterrupt):
+        return "fatal"
+    if isinstance(exc, TrainingDivergedError):
         return "fatal"
     if isinstance(exc, _FATAL_TYPES):
         return "fatal"
@@ -68,6 +85,35 @@ def classify_exception(exc: BaseException) -> str:
 
 def is_retryable(exc: BaseException) -> bool:
     return classify_exception(exc) == "retryable"
+
+
+# Traceback tails ending in these are the user's bug even when the captured
+# text carries no gRPC status word.
+_FATAL_TRACEBACK_NAMES = ("ValueError", "TypeError", "KeyError",
+                          "AssertionError", "AttributeError", "IndexError",
+                          "ModuleNotFoundError", "ImportError",
+                          "NotImplementedError", "TrainingDivergedError")
+
+
+def classify_text(text: str) -> str:
+    """``classify_exception`` for captured *text* (a dead worker's stderr):
+    the gang supervisor and bench driver classify children they cannot
+    unpickle an exception object from.
+
+    Fatal evidence first (status patterns, then Python traceback names) —
+    stderr spew often carries incidental CANCELLED/coordination lines from
+    the teardown of a run that actually died on a program error, so the
+    retryable patterns must not get first look. Unknown text defaults to
+    retryable, same reasoning as ``classify_exception``.
+    """
+    if _FATAL_PATTERNS.search(text):
+        return "fatal"
+    for name in _FATAL_TRACEBACK_NAMES:
+        if f"{name}:" in text:
+            return "fatal"
+    # Everything else — recognized retryable patterns and unknown text
+    # alike — restarts; a wasted restart is cheap next to a lost job.
+    return "retryable"
 
 
 @contextlib.contextmanager

@@ -1,12 +1,31 @@
-"""Process launcher — the ``mpirun`` role of HorovodRunner (SURVEY.md §3.5).
+"""Process launcher + gang supervisor — the ``mpirun`` role of HorovodRunner
+(SURVEY.md §3.5), with the failure story the reference never had.
 
 The reference acquired N Spark executor slots in barrier mode and ``mpirun``-ed
-a Python interpreter per slot; Horovod's MPI rendezvous then wired the ring.
-The TPU-native equivalent is *SPMD per host*: every host runs the SAME
-program, and ``jax.distributed`` (gRPC coordination service) provides the
-rendezvous that MPI did. This module supplies the missing piece — actually
-starting those N processes on one machine (tests, single-host multi-process)
-or printing the env recipe for real pods.
+a Python interpreter per slot; Horovod's MPI rendezvous then wired the ring,
+and a dead rank killed the whole job. The TPU-native equivalent is *SPMD per
+host*: every host runs the SAME program, and ``jax.distributed`` (gRPC
+coordination service) provides the rendezvous that MPI did. This module
+supplies the missing pieces — starting those N processes on one machine
+(tests, single-host multi-process) and *supervising* them:
+
+- :func:`launch` spawns the gang and waits in a **concurrent poll loop**:
+  the first nonzero exit is detected within ``poll_s`` (not after the full
+  ``timeout_s`` a sequential per-rank wait would burn while the survivors
+  hang on a collective), the rest of the gang is killed, and the captured
+  stderr rides in the raised :class:`GangFailure`.
+- A **heartbeat watchdog**: ranks touch ``$SPARKDL_HEARTBEAT_DIR/rank{i}.hb``
+  from inside ``fit()``'s step loop (``metrics.touch_heartbeat``); a rank
+  whose beacon goes stale for ``watchdog_s`` marks the gang hung — the
+  failure mode exit codes can never see.
+- :func:`supervise` wraps launch in **budgeted checkpoint-restart**: gang
+  failures are classified (``failures.classify_text`` on the captured
+  stderr); retryable ones relaunch the whole gang with exponential backoff
+  under ``max_restarts``, and workers resume from their checkpoint dir via
+  ``fit(resume=True)`` — at most ``checkpoint_every`` steps lost per
+  failure. A :class:`~sparkdl_tpu.runner.chaos.FaultPlan` passed to
+  ``supervise`` is serialized into the workers' env (``SPARKDL_CHAOS``), so
+  every one of these paths is testable with zero user-script changes.
 
 Contract: ``launch(script, np=N)`` spawns N copies of ``python script`` with
 the coordination env set:
@@ -18,21 +37,69 @@ the coordination env set:
 :class:`XlaRunner` auto-initializes ``jax.distributed`` from these (see
 ``xla_runner._maybe_init_distributed``), so a worker script needs no launcher
 awareness beyond constructing ``XlaRunner(...)`` as usual. On a real pod,
-GKE/TPU-VM tooling sets the equivalent variables and no launcher is needed —
-this is for the reference's single-machine ``HorovodRunner(np=N)`` use case.
+GKE/TPU-VM tooling sets the equivalent variables and no launcher is needed.
 
-CLI: ``python -m sparkdl_tpu.runner.launcher --np 2 train.py [args...]``
+This module's own code never touches jax APIs: the supervisor process must
+not initialize a backend (it would grab the chips its own workers need).
+Importing it through the package pulls jax into the interpreter (the
+``runner`` __init__ imports sibling modules), which is inert — backend
+initialization only happens on the first device query, and the supervisor
+never makes one.
+
+CLI: ``python -m sparkdl_tpu.runner.launcher --np 2 [--restarts R]
+[--watchdog S] train.py [args...]``
 """
 
 from __future__ import annotations
 
+import dataclasses
+import logging
 import os
+import shutil
 import socket
 import subprocess
 import sys
+import tempfile
+import threading
 import time
 
-__all__ = ["launch", "free_port"]
+from . import failures
+from .chaos import FaultPlan
+
+__all__ = ["launch", "supervise", "free_port", "GangFailure",
+           "SuperviseResult"]
+
+log = logging.getLogger("sparkdl_tpu.runner")
+
+_KILL_GRACE_S = 2.0  # SIGTERM -> SIGKILL escalation window
+
+
+class GangFailure(RuntimeError):
+    """A gang attempt failed. ``kind`` is the restart policy verdict
+    ("retryable"/"fatal"), ``hung`` marks watchdog/timeout detections, and
+    ``results`` holds whatever per-rank output was salvaged (None for ranks
+    still running when the gang was killed)."""
+
+    def __init__(self, message: str, kind: str = "retryable",
+                 hung: bool = False, results: list | None = None):
+        super().__init__(message)
+        self.kind = kind
+        self.hung = hung
+        self.results = results or []
+
+
+@dataclasses.dataclass
+class SuperviseResult:
+    """What :func:`supervise` returns: the final (successful) gang's
+    per-rank results plus the recovery ledger."""
+    results: list
+    restarts: int
+    attempts: int
+    failure_kinds: list
+
+    @property
+    def last_failure_kind(self) -> str | None:
+        return self.failure_kinds[-1] if self.failure_kinds else None
 
 
 def free_port() -> int:
@@ -42,23 +109,72 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
-def launch(script: str, np: int = 2, args: list[str] | None = None,
-           env: dict | None = None, timeout_s: float = 600.0,
-           coordinator: str | None = None,
-           capture: bool = False) -> list[subprocess.CompletedProcess]:
-    """Spawn ``np`` copies of ``python script`` wired for jax.distributed.
+class _Drain:
+    """Background readers for a child's pipes: the poll loop must never
+    block on I/O, and a worker must never block on a full pipe while the
+    supervisor is polling its siblings.
 
-    Blocks until all workers exit; raises ``RuntimeError`` naming the failed
-    ranks if any returncode is nonzero (after terminating stragglers, so a
-    dead rank can't leave the rest hung on a collective forever).
-
-    ``capture=True`` collects each worker's stdout/stderr into the returned
-    ``CompletedProcess``es (workers otherwise inherit this process's streams).
+    Retention is TAIL-bounded (``cap_bytes`` per stream): a multi-day gang
+    logging per-step metrics must not grow the supervisor's RSS without
+    bound, and classification/postmortems only ever read the tail anyway.
     """
-    if np < 1:
-        raise ValueError(f"np must be >= 1, got {np}")
+
+    def __init__(self, proc: subprocess.Popen,
+                 cap_bytes: int = 2 * 1024 * 1024):
+        self._cap = cap_bytes
+        self._out: list[str] = []
+        self._err: list[str] = []
+        self._truncated = {id(self._out): False, id(self._err): False}
+        self._threads = []
+        for stream, sink in ((proc.stdout, self._out),
+                             (proc.stderr, self._err)):
+            if stream is None:
+                continue
+            t = threading.Thread(target=self._pump, args=(stream, sink),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _pump(self, stream, sink):
+        size = 0
+        try:
+            for line in stream:
+                sink.append(line)
+                size += len(line)
+                while size > self._cap and len(sink) > 1:
+                    size -= len(sink.pop(0))
+                    self._truncated[id(sink)] = True
+        except ValueError:
+            pass  # stream closed under us during gang kill
+        finally:
+            try:
+                stream.close()
+            except OSError:
+                pass
+
+    def join(self, timeout: float = 5.0):
+        for t in self._threads:
+            t.join(timeout)
+
+    def _text(self, sink) -> str:
+        head = "[... earlier output dropped ...]\n" \
+            if self._truncated[id(sink)] else ""
+        return head + "".join(sink)
+
+    @property
+    def stdout(self) -> str:
+        return self._text(self._out)
+
+    @property
+    def stderr(self) -> str:
+        return self._text(self._err)
+
+
+def _spawn_gang(script: str, np: int, args, env, coordinator: str | None,
+                capture: bool, heartbeat_dir: str | None = None):
     coordinator = coordinator or f"127.0.0.1:{free_port()}"
     procs: list[subprocess.Popen] = []
+    drains: list[_Drain] = []
     for rank in range(np):
         penv = dict(os.environ)
         penv.update(env or {})
@@ -67,54 +183,330 @@ def launch(script: str, np: int = 2, args: list[str] | None = None,
             "SPARKDL_NUM_PROCESSES": str(np),
             "SPARKDL_PROCESS_ID": str(rank),
         })
-        procs.append(subprocess.Popen(
+        if heartbeat_dir:
+            penv["SPARKDL_HEARTBEAT_DIR"] = heartbeat_dir
+        p = subprocess.Popen(
             [sys.executable, script] + list(args or []),
             env=penv,
             stdout=subprocess.PIPE if capture else None,
             stderr=subprocess.PIPE if capture else None,
-            text=True))
+            text=True)
+        procs.append(p)
+        drains.append(_Drain(p))
+    return procs, drains
 
-    deadline = time.monotonic() + timeout_s
-    results: list[subprocess.CompletedProcess | None] = [None] * np
-    try:
-        for rank, p in enumerate(procs):
-            remaining = max(1.0, deadline - time.monotonic())
-            out, err = p.communicate(timeout=remaining)
-            results[rank] = subprocess.CompletedProcess(
-                p.args, p.returncode, out, err)
-    except subprocess.TimeoutExpired:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-        raise RuntimeError(
-            f"launch: workers did not finish within {timeout_s}s "
-            "(rendezvous hang? a dead peer blocks collectives)")
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
 
-    failed = [r for r, res in enumerate(results) if res.returncode != 0]
-    if failed:
-        detail = ""
+def _kill_gang(procs: list[subprocess.Popen]):
+    """Terminate every still-running rank: SIGTERM, a short grace, SIGKILL.
+    A dead peer leaves survivors blocked inside a collective — they will
+    not exit on their own."""
+    running = [p for p in procs if p.poll() is None]
+    for p in running:
+        try:
+            p.terminate()
+        except OSError:
+            pass
+    deadline = time.monotonic() + _KILL_GRACE_S
+    for p in running:
+        try:
+            p.wait(timeout=max(0.05, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+    for p in running:
+        try:
+            p.wait(timeout=_KILL_GRACE_S)
+        except subprocess.TimeoutExpired:
+            pass
+
+
+def _heartbeat_ages(heartbeat_dir: str, np: int,
+                    now: float) -> dict[int, tuple[float, str]]:
+    """rank -> (seconds since last beat, last step written). Ranks that
+    never beat yet are absent — a rank is watchdog-eligible only after its
+    first heartbeat (startup compile time must not trip the watchdog; a
+    hang *before* the first step is ``timeout_s``'s job)."""
+    ages = {}
+    for rank in range(np):
+        path = os.path.join(heartbeat_dir, f"rank{rank}.hb")
+        try:
+            st = os.stat(path)
+            with open(path) as f:
+                step = f.read().strip()
+            ages[rank] = (now - st.st_mtime, step)
+        except OSError:
+            continue
+    return ages
+
+
+def _clear_heartbeats(heartbeat_dir: str, np: int):
+    for rank in range(np):
+        try:
+            os.unlink(os.path.join(heartbeat_dir, f"rank{rank}.hb"))
+        except OSError:
+            pass
+
+
+def _collect(procs, drains, capture: bool):
+    """Per-rank CompletedProcess list; None for ranks with no exit code
+    (cannot happen after _kill_gang, but be defensive)."""
+    results = []
+    for p, d in zip(procs, drains):
         if capture:
-            r = results[failed[0]]
-            detail = "\n" + (r.stderr or r.stdout or "")[-2000:]
-        raise RuntimeError(f"launch: rank(s) {failed} exited nonzero{detail}")
-    return results  # type: ignore[return-value]
+            d.join()
+        rc = p.poll()
+        results.append(None if rc is None else subprocess.CompletedProcess(
+            p.args, rc, d.stdout if capture else None,
+            d.stderr if capture else None))
+    return results
+
+
+def _rank_tail(results, rank: int, n: int = 2000) -> str:
+    r = results[rank] if rank < len(results) else None
+    if r is None:
+        return ""
+    return (r.stderr or r.stdout or "")[-n:]
+
+
+def _run_gang(script: str, np: int, args, env, timeout_s: float,
+              coordinator: str | None, capture: bool, poll_s: float,
+              heartbeat_dir: str | None, watchdog_s: float | None):
+    """One gang attempt. Returns (status, results, info):
+
+    - ("ok", results, {})           — every rank exited 0
+    - ("failed", results, {ranks})  — first nonzero exit (within poll_s)
+    - ("hung", results, {rank, age, step}) — heartbeat went stale
+    - ("timeout", results, {running}) — wall deadline hit
+    """
+    if heartbeat_dir:
+        # Stale beats from a previous attempt/run would trip the watchdog
+        # on the first poll of a freshly spawned gang.
+        _clear_heartbeats(heartbeat_dir, np)
+    procs, drains = _spawn_gang(script, np, args, env, coordinator, capture,
+                                heartbeat_dir=heartbeat_dir)
+    t0 = time.monotonic()
+    deadline = t0 + timeout_s
+    try:
+        while True:
+            codes = [p.poll() for p in procs]
+            failed = [r for r, c in enumerate(codes) if c not in (None, 0)]
+            if failed:
+                _kill_gang(procs)
+                return ("failed", _collect(procs, drains, capture),
+                        {"ranks": failed,
+                         "detect_s": time.monotonic() - t0})
+            if all(c == 0 for c in codes):
+                return "ok", _collect(procs, drains, capture), {}
+            if watchdog_s and heartbeat_dir:
+                now = time.time()
+                ages = _heartbeat_ages(heartbeat_dir, np, now)
+                stale = [(r, a, s) for r, (a, s) in ages.items()
+                         if codes[r] is None and a > watchdog_s]
+                if stale:
+                    rank, age, step = max(stale, key=lambda x: x[1])
+                    _kill_gang(procs)
+                    return ("hung", _collect(procs, drains, capture),
+                            {"rank": rank, "age": age, "step": step,
+                             "ages": {r: round(a, 1)
+                                      for r, (a, _) in ages.items()}})
+            if time.monotonic() > deadline:
+                running = [r for r, c in enumerate(codes) if c is None]
+                _kill_gang(procs)
+                info = {"running": running}
+                if heartbeat_dir:
+                    info["ages"] = {
+                        r: round(a, 1) for r, (a, _) in
+                        _heartbeat_ages(heartbeat_dir, np,
+                                        time.time()).items()}
+                return "timeout", _collect(procs, drains, capture), info
+            time.sleep(poll_s)
+    finally:
+        _kill_gang(procs)
+
+
+def _failure(status: str, results, info, timeout_s: float,
+             capture: bool) -> GangFailure:
+    """Build the GangFailure for a non-ok attempt: message carries the
+    postmortem (which ranks died/stalled + salvaged stderr), ``kind``
+    carries the restart-policy verdict."""
+    if status == "failed":
+        ranks = info["ranks"]
+        first = ranks[0]
+        tail = _rank_tail(results, first)
+        rc = results[first].returncode if results[first] else None
+        # Killed-by-signal (negative rc) with no stderr reads like a
+        # preemption/OOM-kill — retryable. Otherwise classify the text.
+        kind = ("retryable" if (rc is not None and rc < 0 and not tail)
+                else failures.classify_text(tail))
+        msg = (f"launch: rank(s) {ranks} exited nonzero "
+               f"(rank {first} rc={rc}, detected in "
+               f"{info.get('detect_s', 0.0):.1f}s, classified {kind})")
+        if tail:
+            msg += "\n" + tail
+        return GangFailure(msg, kind=kind, results=results)
+    if status == "hung":
+        msg = (f"launch: heartbeat watchdog tripped — rank {info['rank']} "
+               f"last beat {info['age']:.1f}s ago (at step "
+               f"{info['step'] or '?'}); per-rank heartbeat ages: "
+               f"{info.get('ages')}")
+        return GangFailure(msg, kind="retryable", hung=True, results=results)
+    # timeout: salvage whatever completed ranks left behind so the
+    # postmortem shows WHICH rank stopped making progress.
+    running = info.get("running", [])
+    done = [r for r, res in enumerate(results)
+            if res is not None and r not in running]
+    msg = (f"launch: workers did not finish within {timeout_s}s "
+           f"(rendezvous hang? a dead peer blocks collectives); "
+           f"rank(s) {running} still running, rank(s) {done} had exited")
+    if info.get("ages"):
+        msg += f"; last heartbeat ages: {info['ages']}"
+    if capture:
+        for r, res in enumerate(results):
+            if res is None:
+                continue
+            tail = (res.stderr or res.stdout or "")[-800:]
+            if tail:
+                msg += f"\n--- rank {r} (rc={res.returncode}) ---\n{tail}"
+    return GangFailure(msg, kind="retryable", hung=True, results=results)
+
+
+def launch(script: str, np: int = 2, args: list[str] | None = None,
+           env: dict | None = None, timeout_s: float = 600.0,
+           coordinator: str | None = None,
+           capture: bool = False, poll_s: float = 0.5,
+           heartbeat_dir: str | None = None,
+           watchdog_s: float | None = None
+           ) -> list[subprocess.CompletedProcess]:
+    """Spawn ``np`` copies of ``python script`` wired for jax.distributed.
+
+    Blocks until all workers exit. The wait is a concurrent poll loop: the
+    first nonzero exit is detected within ``poll_s`` and the surviving
+    ranks are killed immediately (a dead peer leaves them hung on a
+    collective — the old sequential wait burned the full ``timeout_s``
+    before noticing). Raises :class:`GangFailure` (a ``RuntimeError``)
+    carrying the failed ranks, salvaged stderr, and the retryable/fatal
+    classification.
+
+    ``capture=True`` collects each worker's stdout/stderr (drained
+    concurrently — a chatty worker can't deadlock the poll loop).
+    ``watchdog_s`` + ``heartbeat_dir`` arm the hang watchdog (see module
+    docstring).
+    """
+    if np < 1:
+        raise ValueError(f"np must be >= 1, got {np}")
+    status, results, info = _run_gang(
+        script, np, args, env, timeout_s, coordinator, capture, poll_s,
+        heartbeat_dir, watchdog_s)
+    if status == "ok":
+        return results
+    raise _failure(status, results, info, timeout_s, capture)
+
+
+def supervise(script: str, np: int = 2, args: list[str] | None = None,
+              env: dict | None = None, timeout_s: float = 600.0,
+              max_restarts: int = 2, backoff_s: float = 1.0,
+              poll_s: float = 0.5, watchdog_s: float | None = None,
+              heartbeat_dir: str | None = None, capture: bool = True,
+              plan: FaultPlan | None = None,
+              retry_all: bool = False) -> SuperviseResult:
+    """Budgeted checkpoint-restart supervision of a worker gang — the
+    multi-process twin of ``XlaRunner.run_with_restarts`` (SURVEY.md §5.3).
+
+    Each attempt launches the full gang (fresh coordinator port per
+    attempt). On failure the captured stderr is classified
+    (``failures.classify_text``): retryable — preemption, crash-by-signal,
+    hang (watchdog or timeout) — relaunches after ``backoff_s * 2**n``
+    under the ``max_restarts`` budget; fatal re-raises immediately
+    (``retry_all=True`` restores indiscriminate retry). Workers that pass
+    a ``checkpoint_dir`` to ``fit(resume=True)`` resume from
+    ``CheckpointManager.latest_step`` — a restart loses at most
+    ``checkpoint_every`` steps.
+
+    ``watchdog_s`` arms the heartbeat hang watchdog (a temp heartbeat dir
+    is created when none is given; workers find it via
+    ``SPARKDL_HEARTBEAT_DIR``). ``plan`` injects a chaos
+    :class:`~sparkdl_tpu.runner.chaos.FaultPlan` into the workers' env; a
+    plan without a ``state_dir`` gets a temp one so ``once`` faults stay
+    once across relaunches.
+    """
+    if np < 1:
+        raise ValueError(f"np must be >= 1, got {np}")
+    env = dict(env or {})
+    tmp_dirs = []  # created-by-us scratch, removed on success only
+    if plan is not None:
+        if plan.state_dir is None:
+            plan = dataclasses.replace(
+                plan, faults=list(plan.faults),
+                state_dir=tempfile.mkdtemp(prefix="sparkdl-chaos-"))
+            tmp_dirs.append(plan.state_dir)
+        env.update(plan.to_env())
+    if watchdog_s and not heartbeat_dir:
+        heartbeat_dir = tempfile.mkdtemp(prefix="sparkdl-hb-")
+        tmp_dirs.append(heartbeat_dir)
+    if heartbeat_dir:
+        os.makedirs(heartbeat_dir, exist_ok=True)
+        env["SPARKDL_HEARTBEAT_DIR"] = heartbeat_dir
+
+    restarts = 0
+    kinds: list[str] = []
+    while True:
+        # (_run_gang clears attempt N-1's heartbeats before spawning)
+        status, results, info = _run_gang(
+            script, np, args, env, timeout_s, None, capture, poll_s,
+            heartbeat_dir, watchdog_s)
+        if status == "ok":
+            for d in tmp_dirs:  # kept on failure paths for postmortems
+                shutil.rmtree(d, ignore_errors=True)
+            return SuperviseResult(results=results, restarts=restarts,
+                                   attempts=restarts + 1,
+                                   failure_kinds=kinds)
+        err = _failure(status, results, info, timeout_s, capture)
+        kinds.append(err.kind)
+        if (err.kind == "fatal" and not retry_all) \
+                or restarts >= max_restarts:
+            err.args = (f"{err}\n(supervise: giving up after {restarts} "
+                        f"restart(s) of budget {max_restarts}; failure "
+                        f"kinds: {kinds})",)
+            raise err
+        restarts += 1
+        backoff = backoff_s * (2 ** (restarts - 1))
+        log.warning("supervise: gang attempt %d failed (%s); relaunching "
+                    "in %.1fs (restart %d/%d)\n%s", restarts, err.kind,
+                    backoff, restarts, max_restarts, str(err)[:1000])
+        time.sleep(backoff)
 
 
 def main(argv: list[str] | None = None) -> int:
     import argparse
     ap = argparse.ArgumentParser(
-        description="Launch N jax.distributed worker processes "
-                    "(HorovodRunner's mpirun role)")
+        description="Launch and supervise N jax.distributed worker "
+                    "processes (HorovodRunner's mpirun role)")
     ap.add_argument("--np", type=int, default=2)
     ap.add_argument("--timeout", type=float, default=3600.0)
+    ap.add_argument("--restarts", type=int, default=0,
+                    help="restart budget for retryable gang failures")
+    ap.add_argument("--watchdog", type=float, default=None,
+                    help="heartbeat staleness (s) that marks the gang hung")
     ap.add_argument("script")
     ap.add_argument("args", nargs=argparse.REMAINDER)
     ns = ap.parse_args(argv)
-    launch(ns.script, np=ns.np, args=ns.args, timeout_s=ns.timeout)
+    if ns.restarts or ns.watchdog:
+        # capture=True: the fatal/retryable verdict classifies the workers'
+        # stderr — without pipes every death would look retryable and a
+        # user bug would be relaunched until the budget ran out. Output is
+        # replayed per rank after the run instead of streaming live.
+        res = supervise(ns.script, np=ns.np, args=ns.args,
+                        timeout_s=ns.timeout, max_restarts=ns.restarts,
+                        watchdog_s=ns.watchdog, capture=True)
+        for rank, r in enumerate(res.results):
+            if r is not None and (r.stdout or r.stderr):
+                print(f"--- rank {rank} ---\n{r.stdout or ''}", end="")
+                if r.stderr:
+                    print(r.stderr, end="", file=sys.stderr)
+        if res.restarts:
+            print(f"launcher: completed after {res.restarts} restart(s)",
+                  file=sys.stderr)
+    else:
+        launch(ns.script, np=ns.np, args=ns.args, timeout_s=ns.timeout)
     return 0
 
 

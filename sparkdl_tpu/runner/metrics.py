@@ -11,12 +11,79 @@ from __future__ import annotations
 import contextlib
 import json
 import logging
+import os
 import time
 from dataclasses import dataclass, field
 
 import jax
 
 log = logging.getLogger("sparkdl_tpu.runner")
+
+
+@dataclass
+class RunStats:
+    """Process-wide failure/recovery counters (ISSUE 1 tentpole): the
+    restart machinery and the chaos subsystem both record here so the
+    emitted metrics JSON carries ``restarts``, ``faults_injected``, and
+    ``last_failure_kind`` next to the throughput numbers.
+
+    ``run_with_restarts`` records restarts/failures; ``chaos.fire`` records
+    injections; ``bench.py`` merges a worker's snapshot into its record.
+    Cumulative per process — tests isolate with ``reset()``.
+    """
+    restarts: int = 0
+    faults_injected: int = 0
+    last_failure_kind: str | None = None
+    last_failure: str | None = None
+    fault_sites: list = field(default_factory=list)
+
+    def record_restart(self):
+        self.restarts += 1
+
+    def record_failure(self, kind: str, detail: str | None = None):
+        self.last_failure_kind = kind
+        self.last_failure = (detail or "")[:500] or None
+
+    def record_fault(self, site: str, kind: str):
+        self.faults_injected += 1
+        self.fault_sites.append(f"{site}:{kind}")
+
+    def snapshot(self) -> dict:
+        return {"restarts": self.restarts,
+                "faults_injected": self.faults_injected,
+                "last_failure_kind": self.last_failure_kind,
+                "last_failure": self.last_failure,
+                "fault_sites": list(self.fault_sites)}
+
+    def reset(self):
+        self.restarts = 0
+        self.faults_injected = 0
+        self.last_failure_kind = None
+        self.last_failure = None
+        self.fault_sites = []
+
+
+run_stats = RunStats()
+
+
+def touch_heartbeat(step: int | None = None):
+    """Per-rank liveness beacon for the gang supervisor's hang watchdog.
+
+    ``fit()`` calls this every step; with ``SPARKDL_HEARTBEAT_DIR`` unset
+    (the non-supervised case) it is a no-op. The file body is the step
+    number, so a hang postmortem shows where each rank stopped making
+    progress, not just when.
+    """
+    hb_dir = os.environ.get("SPARKDL_HEARTBEAT_DIR")
+    if not hb_dir:
+        return
+    rank = os.environ.get("SPARKDL_PROCESS_ID", "0")
+    try:
+        os.makedirs(hb_dir, exist_ok=True)
+        with open(os.path.join(hb_dir, f"rank{rank}.hb"), "w") as f:
+            f.write("" if step is None else str(step))
+    except OSError:  # a torn-down tmpdir must not kill the train loop
+        pass
 
 
 @dataclass

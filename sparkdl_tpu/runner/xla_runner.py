@@ -33,8 +33,10 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core import runtime
+from . import chaos
 from . import metrics as metrics_lib
 from .checkpoint import CheckpointManager
+from .failures import TrainingDivergedError
 from .train_state import (TrainState, make_eval_step, make_shard_map_step,
                           make_train_step)
 
@@ -307,6 +309,9 @@ class RunnerContext:
                     batch = _crop(batch)
                     if batch is None:
                         continue
+                    batch = chaos.fire("batch_fetch",
+                                       step=start_step + produced,
+                                       batch=batch)
                     produced += 1
                     yield batch
 
@@ -325,8 +330,11 @@ class RunnerContext:
         staged_it = _staged(num_steps - start_step)
         if profile_dir:
             jax.profiler.start_trace(profile_dir)
+        last_m = None
         try:
             for i in range(start_step, num_steps):
+                # Per-step fault-injection hook (no-op without a plan).
+                chaos.fire("step_start", step=i)
                 try:
                     n_local, sharded = next(staged_it)
                 except StopIteration:
@@ -337,19 +345,34 @@ class RunnerContext:
                 n = n_local * self.num_processes
                 with metrics_lib.step_annotation(i):
                     state, m = step_fn(state, sharded)
+                # Liveness beacon for the gang supervisor's hang watchdog
+                # (no-op unless SPARKDL_HEARTBEAT_DIR is set). AFTER the
+                # step call, not before it: a rank becomes watchdog-
+                # eligible at its first beat, and the first step_fn call
+                # blocks through XLA compilation — beating first would arm
+                # the watchdog and then let a >watchdog_s compile read as
+                # a hang, deterministically burning the restart budget.
+                metrics_lib.touch_heartbeat(i)
                 # Host sync only at metering/logging boundaries; otherwise
                 # steps stay enqueued and transfers overlap compute.
+                last_m = m
                 if (i + 1) % log_every == 0 or i + 1 == num_steps:
                     m = {k: float(v) for k, v in m.items()}
+                    _assert_finite_loss(m, i + 1)
                     meter.update(n)
                     m["examples_per_sec_per_chip"] = \
                         meter.recent_examples_per_sec() / max(self.size, 1)
                     logger.log(i + 1, m)
                     history.append({"step": i + 1, **m})
+                    last_m = m
                 else:
                     meter.update(n)
                 if checkpoint_every and self.checkpoints and \
                         (i + 1) % checkpoint_every == 0:
+                    # Divergence guard BEFORE the save: a NaN checkpoint
+                    # would poison every subsequent resume (the host sync
+                    # it costs rides the checkpoint's own sync cadence).
+                    _assert_finite_loss(m, i + 1)
                     self.checkpoints.save(i + 1, state)
                 if eval_step and eval_every and (i + 1) % eval_every == 0 \
                         and eval_data is not None:
@@ -361,11 +384,37 @@ class RunnerContext:
                 pool.shutdown(wait=False, cancel_futures=True)
             if profile_dir:
                 jax.profiler.stop_trace()
+            # Finalize in-flight async checkpoint saves even when the loop
+            # is unwinding on a failure: the whole point of dying mid-run
+            # is resuming from the last save, which must not be left
+            # half-committed (latest_step would skip it and the restart
+            # would silently redo checkpoint_every extra steps).
+            if self._ckpt is not None:
+                try:
+                    self._ckpt.wait()
+                except Exception:
+                    log.warning("checkpoint finalize on exit failed",
+                                exc_info=True)
         jax.block_until_ready(state.params)
         if self.checkpoints:
+            if last_m is not None:
+                _assert_finite_loss(last_m, int(state.step))
             self.checkpoints.save(num_steps, state, wait=True)
         logger.close()
         return {"state": state, "meter": meter, "history": history}
+
+
+def _assert_finite_loss(m: dict, step: int):
+    """The train-loop divergence guard (ISSUE 1 tentpole): a NaN/inf loss
+    is the user's bug (or poisoned data) — fail fast as FATAL with the
+    offending step instead of checkpointing garbage or letting the restart
+    budget burn on a failure that will recur deterministically."""
+    v = m.get("loss")
+    if v is None:
+        return
+    v = float(v)  # device value at checkpoint boundaries: forces the sync
+    if not np.isfinite(v):
+        raise TrainingDivergedError(step, v)
 
 
 def _run_eval(eval_step, state, eval_data, shard):
@@ -425,6 +474,7 @@ class XlaRunner:
         Unlike HorovodRunner there is no pickling/forking: SPMD means one
         program, and that program is already here.
         """
+        chaos.fire("worker")  # worker-start chaos site (no-op unplanned)
         ctx = self.make_context()
         _CURRENT_CONTEXT.append(ctx)
         try:
@@ -460,10 +510,13 @@ class XlaRunner:
                 return self.run(main_fn, **kwargs)
             except Exception as e:
                 kind = failures.classify_exception(e)
+                metrics_lib.run_stats.record_failure(
+                    kind, f"{type(e).__name__}: {e}")
                 attempt += 1
                 if (kind == "fatal" and not retry_all) \
                         or attempt > max_restarts:
                     raise
+                metrics_lib.run_stats.record_restart()
                 log.exception("run failed (%s); restart %d/%d", kind,
                               attempt, max_restarts)
                 time.sleep(backoff_s * attempt)

@@ -1,0 +1,57 @@
+"""Worker program for the multi-process chaos tests (launched by
+runner.launcher.supervise — NOT collected by pytest).
+
+Each process: rendezvous via the launcher's SPARKDL_* env, then train a tiny
+linear classifier through ``ctx.fit`` — which runs the chaos hooks
+(``SPARKDL_CHAOS`` from the supervisor's FaultPlan) and the heartbeat touch
+(``SPARKDL_HEARTBEAT_DIR``). A plan that SIGKILLs one rank mid-run exercises
+the supervisor's prompt dead-rank detection + gang relaunch; the worker
+needs no chaos awareness at all — that is the point.
+
+Usage: chaos_mp_worker.py <out_dir>
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+
+def main():
+    out_dir = sys.argv[1]
+    import numpy as np
+    import optax
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from sparkdl_tpu.runner import XlaRunner, softmax_cross_entropy_loss
+
+    runner = XlaRunner(np=2)
+    assert jax.process_count() == 2, jax.process_count()
+    rank = jax.process_index()
+
+    rng = np.random.RandomState(0)
+    params = {"w": rng.randn(4, 3).astype(np.float32)}
+
+    def data():
+        r = np.random.RandomState(1)
+        while True:
+            x = r.randn(8, 4).astype(np.float32)
+            yield {"image": x, "label": r.randint(0, 3, (8,))}
+
+    def train(ctx):
+        return ctx.fit(loss_fn=softmax_cross_entropy_loss(), params=params,
+                       tx=optax.sgd(0.1), apply_fn=lambda p, x: x @ p["w"],
+                       data=data(), num_steps=4, log_every=100)
+
+    res = runner.run(train)
+    assert int(res["state"].step) == 4
+    with open(os.path.join(out_dir, f"rank{rank}.ok"), "a") as f:
+        f.write("ok\n")
+    print(f"rank {rank} ok", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,257 @@
+"""Chaos subsystem tests (ISSUE 1 tentpole): deterministic fault injection
+through the real training machinery, proving the recovery paths the seed
+only *declared* — checkpoint-resume under injected preemption, fail-fast on
+divergence, heartbeat plumbing — actually execute in tier-1.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import optax
+import pytest
+
+from sparkdl_tpu.runner import (CheckpointManager, Fault, FaultPlan,
+                                InjectedFatal, InjectedPreemption,
+                                TrainingDivergedError, XlaRunner,
+                                classify_exception, run_stats,
+                                softmax_cross_entropy_loss, touch_heartbeat)
+from sparkdl_tpu.runner import chaos
+
+pytestmark = pytest.mark.chaos
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos(monkeypatch):
+    """Every test starts and ends with no plan installed, no env plan, and
+    zeroed process-wide failure counters."""
+    monkeypatch.delenv(chaos.CHAOS_ENV, raising=False)
+    chaos.uninstall()
+    run_stats.reset()
+    yield
+    chaos.uninstall()
+    run_stats.reset()
+
+
+def _linear_apply(params, x):
+    return x @ params["w"]
+
+
+def _params(seed=0):
+    rng = np.random.RandomState(seed)
+    return {"w": rng.randn(4, 3).astype(np.float32)}
+
+
+def _data(n_batches=64, seed=1):
+    rng = np.random.RandomState(seed)
+    for _ in range(n_batches):
+        x = rng.randn(16, 4).astype(np.float32)
+        yield {"image": x, "label": rng.randint(0, 3, (16,))}
+
+
+class TestFaultPlan:
+    def test_env_roundtrip(self):
+        plan = FaultPlan([Fault("step_start", "preempt", at_step=3),
+                          Fault("batch_fetch", "nan", at_step=1, rank=1,
+                                once=False)],
+                         seed=42, state_dir="/tmp/x")
+        env = plan.to_env()
+        back = FaultPlan.from_env(env)
+        assert back.faults == plan.faults
+        assert back.seed == 42 and back.state_dir == "/tmp/x"
+        assert FaultPlan.from_env({}) is None
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="site"):
+            Fault("nowhere", "preempt", at_step=0)
+        with pytest.raises(ValueError, match="kind"):
+            Fault("step_start", "explode", at_step=0)
+        with pytest.raises(ValueError, match="batch_fetch"):
+            Fault("step_start", "nan", at_step=0)
+        with pytest.raises(ValueError, match="trigger"):
+            Fault("step_start", "preempt")  # no at_step, no prob
+
+    def test_at_step_fires_once_and_counts(self):
+        plan = chaos.install(FaultPlan([Fault("step_start", "preempt",
+                                              at_step=2)]))
+        chaos.fire("step_start", step=0)
+        chaos.fire("step_start", step=1)
+        with pytest.raises(InjectedPreemption, match="UNAVAILABLE"):
+            chaos.fire("step_start", step=2)
+        # once=True: same step again does NOT re-fire (restart passed it)
+        chaos.fire("step_start", step=2)
+        assert plan._fired[0] == 1
+        assert run_stats.faults_injected == 1
+        assert run_stats.fault_sites == ["step_start:preempt"]
+
+    def test_prob_trigger_is_seed_deterministic(self):
+        def pattern(seed):
+            plan = FaultPlan([Fault("collective", "hang", prob=0.3,
+                                    once=False, hang_s=0.0)], seed=seed)
+            fired = []
+            for _ in range(64):
+                before = plan._fired[0]
+                plan.fire("collective")
+                fired.append(plan._fired[0] > before)
+            return fired
+
+        a, b = pattern(7), pattern(7)
+        assert a == b
+        assert any(a) and not all(a)  # a real coin, not a constant
+        assert pattern(8) != a
+
+    def test_rank_filter(self, monkeypatch):
+        plan = chaos.install(FaultPlan([Fault("step_start", "preempt",
+                                              at_step=0, rank=1)]))
+        monkeypatch.setenv("SPARKDL_PROCESS_ID", "0")
+        chaos.fire("step_start", step=0)  # wrong rank: no fire
+        assert plan._fired[0] == 0
+        monkeypatch.setenv("SPARKDL_PROCESS_ID", "1")
+        with pytest.raises(InjectedPreemption):
+            chaos.fire("step_start", step=0)
+
+    def test_once_persists_across_plan_instances_via_state_dir(self, tmp_path):
+        plan1 = FaultPlan([Fault("step_start", "preempt", at_step=1)],
+                          state_dir=str(tmp_path))
+        with pytest.raises(InjectedPreemption):
+            plan1.fire("step_start", step=1)
+        # A "restarted process": fresh plan parsed from the same env JSON
+        plan2 = FaultPlan.from_json(plan1.to_json())
+        plan2.fire("step_start", step=1)  # marker file suppresses re-fire
+        assert plan2._fired[0] == 0
+
+    def test_nan_poisons_float_leaves_only(self):
+        chaos.install(FaultPlan([Fault("batch_fetch", "nan", at_step=0)]))
+        batch = {"image": np.ones((4, 2), np.float32),
+                 "label": np.arange(4)}
+        out = chaos.fire("batch_fetch", step=0, batch=batch)
+        assert np.isnan(out["image"]).all()
+        assert (out["label"] == np.arange(4)).all()
+        assert run_stats.faults_injected == 1
+
+    def test_env_autoinstall(self, monkeypatch):
+        plan = FaultPlan([Fault("worker", "fatal", prob=1.0)])
+        monkeypatch.setenv(chaos.CHAOS_ENV, plan.to_json())
+        chaos.uninstall()  # forget the fixture's "env checked" latch
+        with pytest.raises(InjectedFatal, match="INVALID_ARGUMENT"):
+            chaos.fire("worker")
+
+    def test_no_plan_is_noop(self):
+        batch = {"x": np.ones(3)}
+        assert chaos.fire("step_start", step=0, batch=batch) is batch
+
+    def test_injected_errors_classify_correctly(self):
+        assert classify_exception(
+            InjectedPreemption("UNAVAILABLE: injected")) == "retryable"
+        assert classify_exception(
+            InjectedFatal("INVALID_ARGUMENT: injected")) == "fatal"
+        assert classify_exception(TrainingDivergedError(7, float("nan"))) \
+            == "fatal"
+
+
+class TestChaosThroughFit:
+    """The two tier-1 acceptance paths: injected preemption -> one restart,
+    resume from checkpoint, exact stats; injected NaN -> fatal fail-fast,
+    zero restarts consumed."""
+
+    def test_preempt_at_step_k_restarts_once_and_resumes(self, tmp_path):
+        chaos.install(FaultPlan([Fault("step_start", "preempt", at_step=3)]))
+        ckpt = str(tmp_path / "ckpt")
+        params = _params()
+        attempts = []
+
+        def main(ctx):
+            attempts.append(1)
+            return ctx.fit(loss_fn=softmax_cross_entropy_loss(),
+                           params=params, tx=optax.sgd(0.1),
+                           apply_fn=_linear_apply, data=_data(),
+                           num_steps=6, checkpoint_every=2, log_every=100)
+
+        res = XlaRunner(np=8, checkpoint_dir=ckpt).run_with_restarts(
+            main, max_restarts=2, backoff_s=0.0)
+        assert len(attempts) == 2
+        assert int(res["state"].step) == 6
+        # Resume proof: attempt 1 checkpointed at step 2 and died at step 3;
+        # attempt 2 ran steps 2..5 only.
+        assert res["meter"].steps == 4
+        snap = run_stats.snapshot()
+        assert snap["restarts"] == 1
+        assert snap["faults_injected"] == 1
+        assert snap["last_failure_kind"] == "retryable"
+        assert "UNAVAILABLE" in snap["last_failure"]
+
+    def test_nan_batch_fails_fast_fatal_no_restart(self, tmp_path):
+        chaos.install(FaultPlan([Fault("batch_fetch", "nan", at_step=1)]))
+        ckpt = str(tmp_path / "ckpt")
+        attempts = []
+
+        def main(ctx):
+            attempts.append(1)
+            return ctx.fit(loss_fn=softmax_cross_entropy_loss(),
+                           params=_params(), tx=optax.sgd(0.1),
+                           apply_fn=_linear_apply, data=_data(),
+                           num_steps=4, checkpoint_every=2, log_every=1)
+
+        with pytest.raises(TrainingDivergedError) as ei:
+            XlaRunner(np=8, checkpoint_dir=ckpt).run_with_restarts(
+                main, max_restarts=3, backoff_s=0.0)
+        assert ei.value.step == 2  # NaN batch fed step index 1 -> step 2
+        assert len(attempts) == 1  # fatal: no restart consumed
+        snap = run_stats.snapshot()
+        assert snap["restarts"] == 0
+        assert snap["last_failure_kind"] == "fatal"
+        # The guard beat the step-2 checkpoint: nothing garbage on disk.
+        mngr = CheckpointManager(ckpt, async_save=False)
+        assert mngr.latest_step() is None
+        mngr.close()
+
+    def test_fatal_injection_does_not_retry(self):
+        chaos.install(FaultPlan([Fault("step_start", "fatal", at_step=1)]))
+        attempts = []
+
+        def main(ctx):
+            attempts.append(1)
+            return ctx.fit(loss_fn=softmax_cross_entropy_loss(),
+                           params=_params(), tx=optax.sgd(0.1),
+                           apply_fn=_linear_apply, data=_data(),
+                           num_steps=3, log_every=100)
+
+        with pytest.raises(InjectedFatal):
+            XlaRunner(np=8).run_with_restarts(main, max_restarts=3,
+                                              backoff_s=0.0)
+        assert len(attempts) == 1
+
+    def test_fit_touches_heartbeat(self, tmp_path, monkeypatch):
+        hb = tmp_path / "hb"
+        monkeypatch.setenv("SPARKDL_HEARTBEAT_DIR", str(hb))
+        monkeypatch.setenv("SPARKDL_PROCESS_ID", "0")
+        XlaRunner(np=8).run(lambda ctx: ctx.fit(
+            loss_fn=softmax_cross_entropy_loss(), params=_params(),
+            tx=optax.sgd(0.1), apply_fn=_linear_apply, data=_data(),
+            num_steps=3, log_every=100))
+        beat = hb / "rank0.hb"
+        assert beat.exists()
+        assert beat.read_text() == "2"  # last step index the loop reached
+
+    def test_touch_heartbeat_noop_without_env(self, monkeypatch, tmp_path):
+        monkeypatch.delenv("SPARKDL_HEARTBEAT_DIR", raising=False)
+        touch_heartbeat(5)  # must not raise or create anything
+        monkeypatch.setenv("SPARKDL_HEARTBEAT_DIR", str(tmp_path / "hb2"))
+        monkeypatch.setenv("SPARKDL_PROCESS_ID", "3")
+        touch_heartbeat(5)
+        assert (tmp_path / "hb2" / "rank3.hb").read_text() == "5"
+
+
+@pytest.mark.slow
+def test_chaos_smoke_script(tmp_path):
+    """scripts/chaos_smoke.py end-to-end: supervisor + injected preemption
+    + checkpoint resume in real subprocesses on CPU."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "chaos_smoke.py")],
+        capture_output=True, text=True, timeout=420,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert '"ok": true' in proc.stdout, proc.stdout[-2000:]

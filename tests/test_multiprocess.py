@@ -1,21 +1,30 @@
 """Multi-process XlaRunner proof (SURVEY.md §2.5/§3.5 hard-part #1;
-round-1 verdict item 2).
+round-1 verdict item 2) + gang-supervision tests (ISSUE 1 tentpole).
 
 Spawns 2 REAL OS processes via runner.launcher (the mpirun role), each with
 one local CPU device; jax.distributed + gloo provide rendezvous and the
 cross-process collective transport. The worker asserts gradient-allreduce
 equivalence against a single-device reference over the global batch —
 the same equivalence bar the in-process tests use.
+
+The supervision tests use tiny jax-free scripts (fast, tier-1) plus one
+slow real-training gang where a chaos plan SIGKILLs a rank mid-run.
 """
 
 import os
+import sys
+import time
 
 import pytest
 
 from sparkdl_tpu.runner import launcher
+from sparkdl_tpu.runner.chaos import Fault, FaultPlan
+from sparkdl_tpu.runner.launcher import GangFailure, supervise
 
 _WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "mp_worker.py")
+_CHAOS_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "chaos_mp_worker.py")
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -44,3 +53,151 @@ def test_launcher_propagates_failures(tmp_path):
 def test_launcher_rejects_bad_np():
     with pytest.raises(ValueError):
         launcher.launch("x.py", np=0)
+    with pytest.raises(ValueError):
+        supervise("x.py", np=0)
+
+
+class TestGangSupervision:
+    """Poll-loop, watchdog, and restart-budget behavior via tiny jax-free
+    worker scripts — fast enough for tier-1."""
+
+    def test_dead_rank_detected_within_poll_not_timeout(self, tmp_path):
+        """One rank dies while its peer 'hangs on a collective' (sleeps):
+        the old sequential wait burned the full timeout_s; the poll loop
+        must detect, kill the gang, and raise within seconds."""
+        script = tmp_path / "w.py"
+        script.write_text(
+            "import os, sys, time\n"
+            "if os.environ['SPARKDL_PROCESS_ID'] == '1':\n"
+            "    print('boom-rank-1', file=sys.stderr)\n"
+            "    sys.exit(3)\n"
+            "time.sleep(120)\n")
+        t0 = time.monotonic()
+        with pytest.raises(GangFailure) as ei:
+            launcher.launch(str(script), np=2, timeout_s=120.0,
+                            capture=True, poll_s=0.25)
+        wall = time.monotonic() - t0
+        assert wall < 30, f"detection took {wall:.1f}s (poll loop broken?)"
+        assert "rank(s) [1]" in str(ei.value)
+        assert "boom-rank-1" in str(ei.value)  # salvaged stderr
+        assert ei.value.kind == "retryable"
+
+    def test_timeout_salvages_which_rank_stalled(self, tmp_path):
+        """On timeout the error must name the rank that stopped making
+        progress and carry the completed ranks' output (the postmortem
+        the old communicate()-then-raise path threw away)."""
+        script = tmp_path / "w.py"
+        script.write_text(
+            "import os, sys, time\n"
+            "if os.environ['SPARKDL_PROCESS_ID'] == '0':\n"
+            "    print('rank0-finished-cleanly', file=sys.stderr)\n"
+            "    sys.exit(0)\n"
+            "time.sleep(120)\n")
+        with pytest.raises(GangFailure) as ei:
+            launcher.launch(str(script), np=2, timeout_s=4.0, capture=True,
+                            poll_s=0.25)
+        msg = str(ei.value)
+        assert "rank(s) [1] still running" in msg
+        assert "rank(s) [0] had exited" in msg
+        assert "rank0-finished-cleanly" in msg
+        assert ei.value.hung
+
+    def test_watchdog_detects_stale_heartbeat(self, tmp_path):
+        """A rank that beats once then stalls must be caught by the
+        heartbeat watchdog long before timeout_s."""
+        hb = tmp_path / "hb"
+        hb.mkdir()
+        script = tmp_path / "w.py"
+        script.write_text(
+            "import os, time\n"
+            "d = os.environ['SPARKDL_HEARTBEAT_DIR']\n"
+            "r = os.environ['SPARKDL_PROCESS_ID']\n"
+            "open(os.path.join(d, 'rank%s.hb' % r), 'w').write('7')\n"
+            "time.sleep(120)\n")
+        t0 = time.monotonic()
+        with pytest.raises(GangFailure) as ei:
+            launcher.launch(str(script), np=2, timeout_s=120.0,
+                            capture=True, poll_s=0.25,
+                            heartbeat_dir=str(hb), watchdog_s=1.5)
+        wall = time.monotonic() - t0
+        assert wall < 30, f"watchdog took {wall:.1f}s"
+        assert ei.value.hung and ei.value.kind == "retryable"
+        assert "heartbeat watchdog" in str(ei.value)
+        assert "step 7" in str(ei.value)  # where progress stopped
+
+    def test_supervise_restarts_retryable_and_succeeds(self, tmp_path):
+        """First attempt dies with an UNAVAILABLE-shaped error; supervise
+        must classify retryable, relaunch, and report exactly 1 restart."""
+        script = tmp_path / "w.py"
+        # Only rank 0 fails (and only once, via the marker): if both ranks
+        # raced to fail, the gang kill could reach the slower rank before
+        # its marker write and cost a second, nondeterministic restart.
+        script.write_text(
+            "import os, sys\n"
+            "m = sys.argv[1]\n"
+            "if os.environ['SPARKDL_PROCESS_ID'] == '0' "
+            "and not os.path.exists(m):\n"
+            "    open(m, 'w').write('x')\n"
+            "    print('UNAVAILABLE: injected backend flake',"
+            " file=sys.stderr)\n"
+            "    sys.exit(1)\n")
+        res = supervise(str(script), np=2, args=[str(tmp_path / "m")],
+                        timeout_s=60.0, max_restarts=2, backoff_s=0.05,
+                        poll_s=0.25)
+        assert res.restarts == 1 and res.attempts == 2
+        assert res.failure_kinds == ["retryable"]
+        assert all(r.returncode == 0 for r in res.results)
+
+    def test_supervise_fatal_does_not_retry(self, tmp_path):
+        """A ValueError-shaped death must not burn the restart budget."""
+        script = tmp_path / "w.py"
+        script.write_text(
+            "import sys\n"
+            "with open(sys.argv[1], 'a') as f: f.write('attempt\\n')\n"
+            "raise ValueError('user bug')\n")
+        count = tmp_path / "count"
+        with pytest.raises(GangFailure) as ei:
+            supervise(str(script), np=2, args=[str(count)], timeout_s=60.0,
+                      max_restarts=3, backoff_s=0.05, poll_s=0.25)
+        assert ei.value.kind == "fatal"
+        # One attempt only (<= 2 writes: the gang kill may reach the
+        # slower rank before its append) — a retry would write 3+.
+        assert 1 <= count.read_text().count("attempt") <= 2
+
+    def test_supervise_budget_exhaustion(self, tmp_path):
+        script = tmp_path / "w.py"
+        script.write_text("import sys\n"
+                          "print('UNAVAILABLE: forever', file=sys.stderr)\n"
+                          "sys.exit(1)\n")
+        with pytest.raises(GangFailure, match="giving up after 1"):
+            supervise(str(script), np=2, timeout_s=60.0, max_restarts=1,
+                      backoff_s=0.05, poll_s=0.25)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_supervise_sigkilled_rank_relaunches_to_completion(tmp_path):
+    """The acceptance gang test: a chaos plan SIGKILLs rank 1 at step 2 of
+    a real 2-process training run. The supervisor must detect the dead
+    rank within a poll interval (not the full timeout_s), kill the gang,
+    classify retryable, and relaunch to completion within the budget —
+    the plan's state_dir guarantees the kill fires only once."""
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": _REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+    }
+    plan = FaultPlan([Fault("step_start", "sigkill", at_step=2, rank=1)])
+    t0 = time.monotonic()
+    res = supervise(_CHAOS_WORKER, np=2, args=[str(tmp_path)], env=env,
+                    timeout_s=600.0, max_restarts=2, backoff_s=0.1,
+                    poll_s=0.5, plan=plan)
+    wall = time.monotonic() - t0
+    assert res.restarts == 1, res.failure_kinds
+    assert res.failure_kinds == ["retryable"]
+    assert (tmp_path / "rank0.ok").exists()
+    assert (tmp_path / "rank1.ok").exists()
+    # Prompt detection: total wall includes 2 full jax startups but must
+    # sit far below even ONE timeout_s — the old sequential wait would
+    # have burned 600s before noticing the dead rank.
+    assert wall < 300, f"supervise took {wall:.0f}s — timeout-driven?"

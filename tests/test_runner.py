@@ -219,6 +219,26 @@ class TestRunnerApi:
         with pytest.raises(ValueError):
             XlaRunner(np=99)
 
+    def test_init_shutdown_init_cycle(self):
+        """Regression (ISSUE 1 satellite): shutdown() popped the context
+        stack but left _default_runner cached, so a second init() could
+        ride a stale runner. The cycle must yield a FRESH context honoring
+        the new np."""
+        from sparkdl_tpu.runner.xla_runner import current_context
+        ctx1 = hvd.init(np=4)
+        assert ctx1.size == 4
+        hvd.shutdown()
+        assert current_context() is None
+        assert hvd._default_runner is None
+        ctx2 = hvd.init(np=8)
+        try:
+            assert ctx2 is not ctx1
+            assert ctx2.size == 8
+            assert hvd.size() == 8
+        finally:
+            hvd.shutdown()
+        assert current_context() is None
+
     def test_hvd_compat_shim(self):
         def main(ctx):
             assert hvd.size() == 8
