@@ -79,6 +79,25 @@ def _env_flag(name: str) -> bool:
     return os.environ.get(name, "").strip().lower() in ("1", "true", "yes")
 
 
+def _peak_flops() -> float:
+    """Per-chip peak FLOPs/s for MFU: BENCH_PEAK_TFLOPS override (TFLOPs),
+    else the runner's shared device table / SPARKDL_PEAK_FLOPS knob
+    (raw FLOPs), else the v5e bf16 default — so bench MFU and
+    meter.summary() MFU divide by the SAME peak on the same hardware.
+    Worker-side only (the helper queries devices)."""
+    env = os.environ.get("BENCH_PEAK_TFLOPS")
+    if env:
+        return float(env) * 1e12
+    try:
+        from sparkdl_tpu.runner.metrics import peak_flops_per_chip
+        peak = peak_flops_per_chip()
+        if peak:
+            return peak
+    except Exception:
+        pass
+    return 197e12
+
+
 def _apply_platform_env():
     """Honor JAX_PLATFORMS in workers: the axon sitecustomize sets the
     *config* to "axon,cpu" at plugin registration, which overrides the env
@@ -189,7 +208,7 @@ def _worker_resnet50_train() -> dict:
     model_name = os.environ.get("BENCH_MODEL", "ResNet50")
     img = int(os.environ.get("BENCH_IMAGE_SIZE", "224"))
     warmup = 3
-    peak = float(os.environ.get("BENCH_PEAK_TFLOPS", "197")) * 1e12
+    peak = _peak_flops()
 
     runner = XlaRunner(np=-1)
 
@@ -692,7 +711,7 @@ def _worker_bert_train() -> dict:
     seq = int(os.environ.get("BENCH_BERT_SEQ", "128"))
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     warmup = 3
-    peak = float(os.environ.get("BENCH_PEAK_TFLOPS", "197")) * 1e12
+    peak = _peak_flops()
 
     runner = XlaRunner(np=-1)
 
@@ -1248,11 +1267,19 @@ def main():
             # Worker-side failure/chaos ledger rides the result (only when
             # something actually happened — the common all-zero snapshot
             # would just be noise in every leg).
-            from sparkdl_tpu.runner.metrics import run_stats
+            from sparkdl_tpu.runner.metrics import (global_step_stats,
+                                                    run_stats)
             snap = run_stats.snapshot()
             if isinstance(result, dict) and (snap["restarts"] or
                                              snap["faults_injected"]):
                 result.setdefault("failure_stats", snap)
+            # Step-time percentiles (ISSUE 2): whatever trained through a
+            # metered loop in this worker recorded into the process-wide
+            # reservoir — p50/p95/p99/max ride the record next to the
+            # mean-throughput numbers.
+            st = global_step_stats.summary()
+            if isinstance(result, dict) and st:
+                result.setdefault("step_time", st)
         except Exception:
             pass
         print(json.dumps(result))

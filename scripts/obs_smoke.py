@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Observability smoke: flight recorder + gang-timeline postmortem,
+end-to-end through the supervising launcher, on CPU (ISSUE 2 satellite).
+
+Flow: ``supervise(max_restarts=0)`` launches a single-rank training worker
+with the flight recorder armed (``SPARKDL_EVENT_DIR`` is injected by the
+supervisor) and a ``FaultPlan`` that raises an UNAVAILABLE-shaped preemption
+at step 3. The worker dies; ``fit()``'s failure path flushes a crash
+postmortem; the supervisor merges the rank's event stream, postmortem, and
+heartbeat into ``gang_timeline.json`` and raises a :class:`GangFailure`
+carrying it. This script asserts the merged postmortem names the faulted
+rank, its last step, and the chaos site, then prints one JSON line and
+exits 0.
+
+Run: ``JAX_PLATFORMS=cpu python scripts/obs_smoke.py``
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# The supervisor never queries devices, so no jax backend is initialized
+# in this process — the workers own the chips.
+from sparkdl_tpu.runner.chaos import Fault, FaultPlan  # noqa: E402
+from sparkdl_tpu.runner.events import GANG_TIMELINE_FILE  # noqa: E402
+from sparkdl_tpu.runner.launcher import GangFailure, supervise  # noqa: E402
+
+_WORKER = """
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, {repo!r})
+import numpy as np
+import optax
+from sparkdl_tpu.runner import XlaRunner, softmax_cross_entropy_loss
+
+out_dir = sys.argv[1]
+runner = XlaRunner(checkpoint_dir=os.path.join(out_dir, "ckpt"))
+rng = np.random.RandomState(0)
+params = {{"w": rng.randn(4, 3).astype(np.float32)}}
+
+def data():
+    r = np.random.RandomState(1)
+    while True:
+        yield {{"image": r.randn(8, 4).astype(np.float32),
+               "label": r.randint(0, 3, (8,))}}
+
+runner.run(lambda ctx: ctx.fit(
+    loss_fn=softmax_cross_entropy_loss(), params=params, tx=optax.sgd(0.1),
+    apply_fn=lambda p, x: x @ p["w"], data=data(), num_steps=6,
+    checkpoint_every=2, log_every=100))
+"""
+
+
+def main() -> int:
+    out_dir = tempfile.mkdtemp(prefix="sparkdl-obs-smoke-")
+    event_dir = os.path.join(out_dir, "events")
+    worker = os.path.join(out_dir, "worker.py")
+    with open(worker, "w") as f:
+        f.write(_WORKER.format(repo=_REPO))
+
+    plan = FaultPlan([Fault("step_start", "preempt", at_step=3)])
+    err = None
+    try:
+        supervise(worker, np=1, args=[out_dir], timeout_s=300.0,
+                  max_restarts=0, backoff_s=0.1, poll_s=0.25, plan=plan,
+                  event_dir=event_dir)
+    except GangFailure as e:
+        err = e
+
+    tl = err.timeline if err is not None else None
+    merged_path = os.path.join(event_dir, GANG_TIMELINE_FILE)
+    on_disk = {}
+    if os.path.exists(merged_path):
+        with open(merged_path) as f:
+            on_disk = json.load(f)
+    ff = (tl or {}).get("first_failure") or {}
+    ok = (err is not None
+          and tl is not None
+          and tl.get("first_failing_rank") == 0
+          and ff.get("site") == "step_start"
+          and ff.get("step") == 3
+          and (tl["ranks"].get("0") or {}).get("last_step") == 3
+          and on_disk.get("first_failing_rank") == 0
+          and "UNAVAILABLE" in str(err))
+    print(json.dumps({
+        "ok": ok,
+        "first_failing_rank": tl.get("first_failing_rank") if tl else None,
+        "fault_site": ff.get("site"),
+        "fault_step": ff.get("step"),
+        "last_step": (tl["ranks"].get("0") or {}).get("last_step")
+        if tl else None,
+        "gang_timeline": merged_path,
+        "out_dir": out_dir,
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,16 +5,23 @@ the compiled step; process topology is SPMD-per-host, not mpirun-per-slot.
 Failure handling is a first-class subsystem: ``failures.py`` is the
 retryable/fatal policy point, ``launcher.supervise`` the budgeted
 checkpoint-restart gang supervisor, and ``chaos.py`` the deterministic
-fault injector that keeps every recovery path tested.
+fault injector that keeps every recovery path tested. ``events.py`` is the
+observability layer riding all of it: a flight recorder of structured
+per-rank events with crash postmortems and merged gang timelines, plus
+step-time percentiles and MFU in ``ThroughputMeter.summary()``.
 """
 
+from . import events
 from .chaos import Fault, FaultPlan, InjectedFatal, InjectedFault, \
     InjectedPreemption
 from .checkpoint import CheckpointManager, load_portable, save_portable
+from .events import FlightRecorder, Timer, enable_flight_recorder, \
+    merge_timeline
 from .failures import TrainingDivergedError, classify_exception, \
-    classify_text, diagnose_context, is_retryable
+    classify_text, diagnose_context, exception_summary, is_retryable
 from .launcher import GangFailure, SuperviseResult, launch, supervise
-from .metrics import MetricsLogger, ThroughputMeter, debug_mode, run_stats, \
+from .metrics import MetricsLogger, StepTimeStats, ThroughputMeter, \
+    debug_mode, global_step_stats, peak_flops_per_chip, run_stats, \
     touch_heartbeat, trace
 from .train_state import (TrainState, bn_classifier_loss, make_eval_step,
                           make_shard_map_step, make_train_step,
@@ -38,4 +45,7 @@ __all__ = [
     "launch", "supervise", "GangFailure", "SuperviseResult",
     "ThroughputMeter", "MetricsLogger", "trace", "debug_mode",
     "run_stats", "touch_heartbeat",
+    "events", "FlightRecorder", "Timer", "enable_flight_recorder",
+    "merge_timeline", "exception_summary",
+    "StepTimeStats", "global_step_stats", "peak_flops_per_chip",
 ]

@@ -13,6 +13,9 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+# Public observability surface (ISSUE 2): `runner.api.enable_flight_recorder`
+# next to the hvd shims — migrated scripts get tracing with one call.
+from .events import enable_flight_recorder  # noqa: F401
 from .xla_runner import RunnerContext, XlaRunner, current_context
 
 _default_runner: XlaRunner | None = None

@@ -207,14 +207,27 @@ class FaultPlan:
             elif self._rng(idx).random_sample() >= f.prob:
                 continue
             self._mark_fired(idx)
-            _record_fault(site, f.kind)
+            _record_fault(site, f.kind, step)
             out = _execute(f, site, step, out)
         return out
 
 
-def _record_fault(site: str, kind: str):
-    """Count into metrics.run_stats (lazy: metrics imports jax; the
-    supervisor process importing chaos must stay jax-free)."""
+def _record_fault(site: str, kind: str, step=None):
+    """Count into metrics.run_stats and emit a flight-recorder event (lazy
+    imports: metrics pulls jax; the supervisor process importing chaos must
+    stay jax-free — events is stdlib, but symmetry keeps the fire() hot
+    path import-free).
+
+    The event goes FIRST: with ``SPARKDL_EVENT_DIR`` set the line is on
+    disk (line-buffered) before ``_execute`` can SIGKILL the process, so
+    every injected fault is visible in the gang timeline and chaos tests
+    can assert on the trace.
+    """
+    try:
+        from . import events
+        events.event("chaos", site=site, kind=kind, step=step)
+    except Exception:
+        pass
     try:
         from . import metrics as metrics_lib
         metrics_lib.run_stats.record_fault(site, kind)

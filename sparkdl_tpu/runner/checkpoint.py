@@ -41,18 +41,19 @@ class CheckpointManager:
     def save(self, step: int, state: Any, wait: bool = False):
         import orbax.checkpoint as ocp
 
-        from . import chaos
-        chaos.fire("checkpoint_save", step=step)
-        payload = {
-            "params": state.params,
-            "opt_state": state.opt_state,
-            "step": state.step,
-        }
-        if _has_leaves(state.model_state):
-            payload["model_state"] = state.model_state
-        self._mngr.save(step, args=ocp.args.StandardSave(payload))
-        if wait:
-            self._mngr.wait_until_finished()
+        from . import chaos, events
+        with events.span("checkpoint_save", step=step, wait=wait):
+            chaos.fire("checkpoint_save", step=step)
+            payload = {
+                "params": state.params,
+                "opt_state": state.opt_state,
+                "step": state.step,
+            }
+            if _has_leaves(state.model_state):
+                payload["model_state"] = state.model_state
+            self._mngr.save(step, args=ocp.args.StandardSave(payload))
+            if wait:
+                self._mngr.wait_until_finished()
 
     def latest_step(self) -> int | None:
         return self._mngr.latest_step()
@@ -63,6 +64,8 @@ class CheckpointManager:
         import dataclasses
 
         import orbax.checkpoint as ocp
+
+        from . import events
         step = self.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"No checkpoint in {self.directory}")
@@ -73,18 +76,19 @@ class CheckpointManager:
         }
         if _has_leaves(state_template.model_state):
             template["model_state"] = state_template.model_state
-        try:
-            restored = self._mngr.restore(
-                step, args=ocp.args.StandardRestore(template))
-        except ValueError:
-            if "model_state" not in template:
-                raise
-            # On-disk checkpoint predates model_state (saved by a
-            # non-mutable run): restore the rest, keep the template's fresh
-            # model_state.
-            template.pop("model_state")
-            restored = self._mngr.restore(
-                step, args=ocp.args.StandardRestore(template))
+        with events.span("checkpoint_restore", step=step):
+            try:
+                restored = self._mngr.restore(
+                    step, args=ocp.args.StandardRestore(template))
+            except ValueError:
+                if "model_state" not in template:
+                    raise
+                # On-disk checkpoint predates model_state (saved by a
+                # non-mutable run): restore the rest, keep the template's
+                # fresh model_state.
+                template.pop("model_state")
+                restored = self._mngr.restore(
+                    step, args=ocp.args.StandardRestore(template))
         return dataclasses.replace(
             state_template, params=restored["params"],
             opt_state=restored["opt_state"], step=restored["step"],

@@ -87,6 +87,16 @@ def is_retryable(exc: BaseException) -> bool:
     return classify_exception(exc) == "retryable"
 
 
+def exception_summary(exc: BaseException) -> dict:
+    """Compact ``{type, kind, message}`` record for postmortems/telemetry —
+    the flight recorder (``events.postmortem``) and gang timelines embed
+    this so a merged trace carries the retryable/fatal verdict, not just
+    the text."""
+    return {"type": type(exc).__name__,
+            "kind": classify_exception(exc),
+            "message": str(exc)[:2000]}
+
+
 # Traceback tails ending in these are the user's bug even when the captured
 # text carries no gRPC status word.
 _FATAL_TRACEBACK_NAMES = ("ValueError", "TypeError", "KeyError",
@@ -131,11 +141,18 @@ def diagnose_context(interval_s: int = 10):
     context exit would block up to the interval — 10s keeps periodic hang
     evidence flowing without making every wrapped run 10 minutes longer.
     """
+    from . import events
     try:
         from cloud_tpu_diagnostics import diagnostic
         from cloud_tpu_diagnostics.configuration import (
             debug_configuration, diagnostic_configuration,
             stack_trace_configuration)
+
+        # Emitted only once collection is actually armed — a postmortem
+        # must not point the operator at stack traces that were never
+        # going to be written.
+        events.event("diagnose", interval_s=interval_s,
+                     stack_trace_dir="/tmp/debugging")
 
         stack_cfg = stack_trace_configuration.StackTraceConfig(
             collect_stack_trace=True, stack_trace_to_cloud=False,

@@ -63,6 +63,7 @@ import tempfile
 import threading
 import time
 
+from . import events as events_lib
 from . import failures
 from .chaos import FaultPlan
 
@@ -76,16 +77,21 @@ _KILL_GRACE_S = 2.0  # SIGTERM -> SIGKILL escalation window
 
 class GangFailure(RuntimeError):
     """A gang attempt failed. ``kind`` is the restart policy verdict
-    ("retryable"/"fatal"), ``hung`` marks watchdog/timeout detections, and
+    ("retryable"/"fatal"), ``hung`` marks watchdog/timeout detections,
     ``results`` holds whatever per-rank output was salvaged (None for ranks
-    still running when the gang was killed)."""
+    still running when the gang was killed), and ``timeline`` — when the
+    workers streamed flight-recorder events — is the merged gang timeline
+    (``events.merge_timeline``) naming the first-failing rank, its last
+    step, and the fault site."""
 
     def __init__(self, message: str, kind: str = "retryable",
-                 hung: bool = False, results: list | None = None):
+                 hung: bool = False, results: list | None = None,
+                 timeline: dict | None = None):
         super().__init__(message)
         self.kind = kind
         self.hung = hung
         self.results = results or []
+        self.timeline = timeline
 
 
 @dataclasses.dataclass
@@ -171,7 +177,8 @@ class _Drain:
 
 
 def _spawn_gang(script: str, np: int, args, env, coordinator: str | None,
-                capture: bool, heartbeat_dir: str | None = None):
+                capture: bool, heartbeat_dir: str | None = None,
+                event_dir: str | None = None):
     coordinator = coordinator or f"127.0.0.1:{free_port()}"
     procs: list[subprocess.Popen] = []
     drains: list[_Drain] = []
@@ -185,6 +192,8 @@ def _spawn_gang(script: str, np: int, args, env, coordinator: str | None,
         })
         if heartbeat_dir:
             penv["SPARKDL_HEARTBEAT_DIR"] = heartbeat_dir
+        if event_dir:
+            penv["SPARKDL_EVENT_DIR"] = event_dir
         p = subprocess.Popen(
             [sys.executable, script] + list(args or []),
             env=penv,
@@ -219,6 +228,13 @@ def _kill_gang(procs: list[subprocess.Popen]):
             pass
 
 
+def _parse_heartbeat_step(body: str) -> str:
+    """Heartbeat body → step string (format contract decoded in ONE place:
+    ``events.parse_heartbeat_body``)."""
+    step = events_lib.parse_heartbeat_body(body).get("step")
+    return "" if step is None else str(step)
+
+
 def _heartbeat_ages(heartbeat_dir: str, np: int,
                     now: float) -> dict[int, tuple[float, str]]:
     """rank -> (seconds since last beat, last step written). Ranks that
@@ -231,7 +247,7 @@ def _heartbeat_ages(heartbeat_dir: str, np: int,
         try:
             st = os.stat(path)
             with open(path) as f:
-                step = f.read().strip()
+                step = _parse_heartbeat_step(f.read())
             ages[rank] = (now - st.st_mtime, step)
         except OSError:
             continue
@@ -269,7 +285,8 @@ def _rank_tail(results, rank: int, n: int = 2000) -> str:
 
 def _run_gang(script: str, np: int, args, env, timeout_s: float,
               coordinator: str | None, capture: bool, poll_s: float,
-              heartbeat_dir: str | None, watchdog_s: float | None):
+              heartbeat_dir: str | None, watchdog_s: float | None,
+              event_dir: str | None = None):
     """One gang attempt. Returns (status, results, info):
 
     - ("ok", results, {})           — every rank exited 0
@@ -281,8 +298,13 @@ def _run_gang(script: str, np: int, args, env, timeout_s: float,
         # Stale beats from a previous attempt/run would trip the watchdog
         # on the first poll of a freshly spawned gang.
         _clear_heartbeats(heartbeat_dir, np)
+    if event_dir:
+        # Same staleness rule for traces: attempt N's timeline must not
+        # splice attempt N-1's events.
+        events_lib.clear_rank_files(event_dir)
     procs, drains = _spawn_gang(script, np, args, env, coordinator, capture,
-                                heartbeat_dir=heartbeat_dir)
+                                heartbeat_dir=heartbeat_dir,
+                                event_dir=event_dir)
     t0 = time.monotonic()
     deadline = t0 + timeout_s
     try:
@@ -323,11 +345,72 @@ def _run_gang(script: str, np: int, args, env, timeout_s: float,
         _kill_gang(procs)
 
 
-def _failure(status: str, results, info, timeout_s: float,
-             capture: bool) -> GangFailure:
+def _gang_event_subdir(env: dict | None) -> str | None:
+    """Resolve a gang's event dir from an env-var-sourced parent, or None.
+
+    An env-var-sourced dir (the caller's env= dict or this process's
+    environment) may be the dir the driver's OWN recorder is streaming
+    into (``enable_flight_recorder`` sets the same var) — give the gang a
+    UNIQUE subdir so per-attempt clearing can never unlink the driver's
+    live events_rank0.jsonl, and two concurrent gangs sharing the env
+    can't clobber each other's traces. An explicit ``event_dir=`` argument
+    is the caller's deliberate choice and bypasses this."""
+    inherited = (env or {}).get("SPARKDL_EVENT_DIR") or \
+        os.environ.get("SPARKDL_EVENT_DIR")
+    if not inherited:
+        return None
+    try:
+        os.makedirs(inherited, exist_ok=True)
+        return tempfile.mkdtemp(prefix="gang-", dir=inherited)
+    except OSError:
+        return None
+
+
+def _prune_empty_gang_dir(adopted_dir: str | None):
+    """Drop an adopted gang-* subdir that ended up with no files. A
+    NON-empty one is kept even on success: the user exported
+    SPARKDL_EVENT_DIR asking for telemetry, and deleting their streams
+    would break the README's jq-over-the-dir contract; cleanup of
+    accumulated gang-* dirs is the owner's call."""
+    if not adopted_dir:
+        return
+    try:
+        os.rmdir(adopted_dir)  # only succeeds when empty — exactly right
+    except OSError:
+        pass
+
+
+def _gang_timeline(event_dir: str | None, heartbeat_dir: str | None):
+    """Merge the ranks' flight-recorder traces into the gang timeline.
+    Returns (timeline_dict | None, message_suffix). Never raises — a
+    postmortem assembly bug must not replace the primary failure."""
+    if not event_dir:
+        return None, ""
+    try:
+        tl = events_lib.merge_timeline(event_dir,
+                                       heartbeat_dir=heartbeat_dir)
+        # Workers wrote no traces (jax-free scripts): suppress the empty
+        # timeline block. Heartbeat files alone seed rank entries with
+        # n_events=0 — those don't count as a trace.
+        if not any(d.get("n_events") or d.get("postmortem")
+                   for d in tl["ranks"].values()):
+            return None, ""
+        path = events_lib.write_gang_postmortem(event_dir, tl)
+        return tl, "\n" + events_lib.format_timeline(tl) + \
+            f"\n(merged gang timeline: {path})"
+    except Exception:
+        log.warning("gang timeline assembly failed", exc_info=True)
+        return None, ""
+
+
+def _failure(status: str, results, info, timeout_s: float, capture: bool,
+             event_dir: str | None = None,
+             heartbeat_dir: str | None = None) -> GangFailure:
     """Build the GangFailure for a non-ok attempt: message carries the
-    postmortem (which ranks died/stalled + salvaged stderr), ``kind``
-    carries the restart-policy verdict."""
+    postmortem (which ranks died/stalled + salvaged stderr + the merged
+    gang timeline when the workers streamed events), ``kind`` carries the
+    restart-policy verdict."""
+    timeline, tl_msg = _gang_timeline(event_dir, heartbeat_dir)
     if status == "failed":
         ranks = info["ranks"]
         first = ranks[0]
@@ -342,13 +425,15 @@ def _failure(status: str, results, info, timeout_s: float,
                f"{info.get('detect_s', 0.0):.1f}s, classified {kind})")
         if tail:
             msg += "\n" + tail
-        return GangFailure(msg, kind=kind, results=results)
+        return GangFailure(msg + tl_msg, kind=kind, results=results,
+                           timeline=timeline)
     if status == "hung":
         msg = (f"launch: heartbeat watchdog tripped — rank {info['rank']} "
                f"last beat {info['age']:.1f}s ago (at step "
                f"{info['step'] or '?'}); per-rank heartbeat ages: "
                f"{info.get('ages')}")
-        return GangFailure(msg, kind="retryable", hung=True, results=results)
+        return GangFailure(msg + tl_msg, kind="retryable", hung=True,
+                           results=results, timeline=timeline)
     # timeout: salvage whatever completed ranks left behind so the
     # postmortem shows WHICH rank stopped making progress.
     running = info.get("running", [])
@@ -366,7 +451,8 @@ def _failure(status: str, results, info, timeout_s: float,
             tail = (res.stderr or res.stdout or "")[-800:]
             if tail:
                 msg += f"\n--- rank {r} (rc={res.returncode}) ---\n{tail}"
-    return GangFailure(msg, kind="retryable", hung=True, results=results)
+    return GangFailure(msg + tl_msg, kind="retryable", hung=True,
+                       results=results, timeline=timeline)
 
 
 def launch(script: str, np: int = 2, args: list[str] | None = None,
@@ -374,7 +460,8 @@ def launch(script: str, np: int = 2, args: list[str] | None = None,
            coordinator: str | None = None,
            capture: bool = False, poll_s: float = 0.5,
            heartbeat_dir: str | None = None,
-           watchdog_s: float | None = None
+           watchdog_s: float | None = None,
+           event_dir: str | None = None
            ) -> list[subprocess.CompletedProcess]:
     """Spawn ``np`` copies of ``python script`` wired for jax.distributed.
 
@@ -389,16 +476,35 @@ def launch(script: str, np: int = 2, args: list[str] | None = None,
     ``capture=True`` collects each worker's stdout/stderr (drained
     concurrently — a chatty worker can't deadlock the poll loop).
     ``watchdog_s`` + ``heartbeat_dir`` arm the hang watchdog (see module
-    docstring).
+    docstring). ``event_dir`` arms the flight recorder in every rank
+    (``SPARKDL_EVENT_DIR``); on failure the per-rank traces are merged
+    into a gang timeline riding the raised :class:`GangFailure`.
     """
     if np < 1:
         raise ValueError(f"np must be >= 1, got {np}")
+    adopted_dir = None
+    if event_dir is None:
+        # Same isolation rule as supervise(): an env-var-sourced dir may
+        # be the driver's own live recorder stream — give the gang its
+        # own subdir (and by adopting it, a failure here gets a merged
+        # timeline instead of silently skipping it).
+        event_dir = adopted_dir = _gang_event_subdir(env)
+    if event_dir:
+        os.makedirs(event_dir, exist_ok=True)
     status, results, info = _run_gang(
         script, np, args, env, timeout_s, coordinator, capture, poll_s,
-        heartbeat_dir, watchdog_s)
+        heartbeat_dir, watchdog_s, event_dir=event_dir)
     if status == "ok":
+        _prune_empty_gang_dir(adopted_dir)
         return results
-    raise _failure(status, results, info, timeout_s, capture)
+    err = _failure(status, results, info, timeout_s, capture,
+                   event_dir=event_dir, heartbeat_dir=heartbeat_dir)
+    # Workers wrote no traces (jax-free scripts): drop the empty adopted
+    # subdir. rmdir-only-when-empty, NOT rmtree keyed on err.timeline —
+    # timeline assembly can fail with real evidence on disk, and that
+    # evidence must survive.
+    _prune_empty_gang_dir(adopted_dir)
+    raise err
 
 
 def supervise(script: str, np: int = 2, args: list[str] | None = None,
@@ -407,7 +513,8 @@ def supervise(script: str, np: int = 2, args: list[str] | None = None,
               poll_s: float = 0.5, watchdog_s: float | None = None,
               heartbeat_dir: str | None = None, capture: bool = True,
               plan: FaultPlan | None = None,
-              retry_all: bool = False) -> SuperviseResult:
+              retry_all: bool = False,
+              event_dir: str | None = None) -> SuperviseResult:
     """Budgeted checkpoint-restart supervision of a worker gang — the
     multi-process twin of ``XlaRunner.run_with_restarts`` (SURVEY.md §5.3).
 
@@ -427,6 +534,13 @@ def supervise(script: str, np: int = 2, args: list[str] | None = None,
     :class:`~sparkdl_tpu.runner.chaos.FaultPlan` into the workers' env; a
     plan without a ``state_dir`` gets a temp one so ``once`` faults stay
     once across relaunches.
+
+    The flight recorder is armed in every supervised rank: ``event_dir``
+    (or ``SPARKDL_EVENT_DIR`` in ``env``/the supervisor's environment, or
+    a temp dir when neither is given) receives per-rank event streams, and
+    every gang failure carries the merged timeline — which rank failed or
+    stalled first, at what step, at which site. The temp dir is kept on
+    the give-up path for postmortems, removed on success.
     """
     if np < 1:
         raise ValueError(f"np must be >= 1, got {np}")
@@ -445,27 +559,44 @@ def supervise(script: str, np: int = 2, args: list[str] | None = None,
     if heartbeat_dir:
         os.makedirs(heartbeat_dir, exist_ok=True)
         env["SPARKDL_HEARTBEAT_DIR"] = heartbeat_dir
+    adopted_dir = None
+    if event_dir is None:
+        # KEPT on success (unless empty): an exported SPARKDL_EVENT_DIR is
+        # the user asking for telemetry — only the fully auto-created
+        # tempdir below is supervisor scratch that vanishes with the run.
+        event_dir = adopted_dir = _gang_event_subdir(env)
+    if event_dir is None:
+        event_dir = tempfile.mkdtemp(prefix="sparkdl-events-")
+        tmp_dirs.append(event_dir)
+    os.makedirs(event_dir, exist_ok=True)
+    env["SPARKDL_EVENT_DIR"] = event_dir
 
     restarts = 0
     kinds: list[str] = []
     while True:
-        # (_run_gang clears attempt N-1's heartbeats before spawning)
+        # (_run_gang clears attempt N-1's heartbeats/traces before spawning)
         status, results, info = _run_gang(
             script, np, args, env, timeout_s, None, capture, poll_s,
-            heartbeat_dir, watchdog_s)
+            heartbeat_dir, watchdog_s, event_dir=event_dir)
         if status == "ok":
             for d in tmp_dirs:  # kept on failure paths for postmortems
                 shutil.rmtree(d, ignore_errors=True)
+            _prune_empty_gang_dir(adopted_dir)
             return SuperviseResult(results=results, restarts=restarts,
                                    attempts=restarts + 1,
                                    failure_kinds=kinds)
-        err = _failure(status, results, info, timeout_s, capture)
+        err = _failure(status, results, info, timeout_s, capture,
+                       event_dir=event_dir, heartbeat_dir=heartbeat_dir)
         kinds.append(err.kind)
         if (err.kind == "fatal" and not retry_all) \
                 or restarts >= max_restarts:
             err.args = (f"{err}\n(supervise: giving up after {restarts} "
                         f"restart(s) of budget {max_restarts}; failure "
                         f"kinds: {kinds})",)
+            # Same as launch(): an adopted subdir holding no evidence is
+            # just clutter in the user's telemetry dir (rmdir-only-when-
+            # empty — real traces always survive the give-up path).
+            _prune_empty_gang_dir(adopted_dir)
             raise err
         restarts += 1
         backoff = backoff_s * (2 ** (restarts - 1))
@@ -486,6 +617,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="restart budget for retryable gang failures")
     ap.add_argument("--watchdog", type=float, default=None,
                     help="heartbeat staleness (s) that marks the gang hung")
+    ap.add_argument("--event-dir", default=None,
+                    help="flight-recorder dir for per-rank event streams "
+                         "and gang-timeline postmortems (supervise mode "
+                         "defaults to a temp dir)")
     ap.add_argument("script")
     ap.add_argument("args", nargs=argparse.REMAINDER)
     ns = ap.parse_args(argv)
@@ -496,7 +631,8 @@ def main(argv: list[str] | None = None) -> int:
         # replayed per rank after the run instead of streaming live.
         res = supervise(ns.script, np=ns.np, args=ns.args,
                         timeout_s=ns.timeout, max_restarts=ns.restarts,
-                        watchdog_s=ns.watchdog, capture=True)
+                        watchdog_s=ns.watchdog, capture=True,
+                        event_dir=ns.event_dir)
         for rank, r in enumerate(res.results):
             if r is not None and (r.stdout or r.stderr):
                 print(f"--- rank {rank} ---\n{r.stdout or ''}", end="")
@@ -506,7 +642,8 @@ def main(argv: list[str] | None = None) -> int:
             print(f"launcher: completed after {res.restarts} restart(s)",
                   file=sys.stderr)
     else:
-        launch(ns.script, np=ns.np, args=ns.args, timeout_s=ns.timeout)
+        launch(ns.script, np=ns.np, args=ns.args, timeout_s=ns.timeout,
+               event_dir=ns.event_dir)
     return 0
 
 

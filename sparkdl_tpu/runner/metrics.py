@@ -11,11 +11,15 @@ from __future__ import annotations
 import contextlib
 import json
 import logging
+import math
 import os
+import random
 import time
 from dataclasses import dataclass, field
 
 import jax
+
+from . import events
 
 log = logging.getLogger("sparkdl_tpu.runner")
 
@@ -70,9 +74,11 @@ def touch_heartbeat(step: int | None = None):
     """Per-rank liveness beacon for the gang supervisor's hang watchdog.
 
     ``fit()`` calls this every step; with ``SPARKDL_HEARTBEAT_DIR`` unset
-    (the non-supervised case) it is a no-op. The file body is the step
-    number, so a hang postmortem shows where each rank stopped making
-    progress, not just when.
+    (the non-supervised case) it is a no-op. The body is JSON
+    ``{"step": N, "time": <unix>}`` — the step shows where each rank
+    stopped making progress, the wall clock lets postmortems line beats up
+    against the event timeline. Written to a tmp file + ``os.replace`` so
+    the watchdog can never read a torn/empty body mid-write.
     """
     hb_dir = os.environ.get("SPARKDL_HEARTBEAT_DIR")
     if not hb_dir:
@@ -80,10 +86,114 @@ def touch_heartbeat(step: int | None = None):
     rank = os.environ.get("SPARKDL_PROCESS_ID", "0")
     try:
         os.makedirs(hb_dir, exist_ok=True)
-        with open(os.path.join(hb_dir, f"rank{rank}.hb"), "w") as f:
-            f.write("" if step is None else str(step))
+        events.atomic_write_json(
+            os.path.join(hb_dir, f"rank{rank}.hb"),
+            {"step": step, "time": round(time.time(), 3)})
     except OSError:  # a torn-down tmpdir must not kill the train loop
         pass
+
+
+# -- step-time statistics & MFU ----------------------------------------------
+
+# bf16 peak FLOPs/s per chip by device_kind substring (first match wins —
+# "v5 lite"/"v5e" must be probed before a bare "v5"). SPARKDL_PEAK_FLOPS
+# overrides (raw FLOPs, e.g. "197e12").
+_PEAK_FLOPS_BY_KIND = (
+    ("v5 lite", 197e12), ("v5litepod", 197e12), ("v5e", 197e12),
+    ("v5p", 459e12), ("v6 lite", 918e12), ("v6e", 918e12),
+    ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
+)
+
+
+def peak_flops_per_chip() -> float | None:
+    """Per-chip peak FLOPs/s for the MFU denominator: the
+    ``SPARKDL_PEAK_FLOPS`` env override, else the device table keyed on
+    ``device_kind``; None (→ MFU null) when neither knows the hardware."""
+    env = os.environ.get("SPARKDL_PEAK_FLOPS")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            log.warning("ignoring unparseable SPARKDL_PEAK_FLOPS=%r", env)
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return None
+    for pat, val in _PEAK_FLOPS_BY_KIND:
+        if pat in kind:
+            return val
+    return None
+
+
+class StepTimeStats:
+    """Bounded reservoir of per-step wall times → p50/p95/p99/max.
+
+    Reservoir sampling (seeded, deterministic) keeps memory O(capacity)
+    over arbitrarily long runs while ``max`` and ``mean`` stay exact over
+    ALL recorded steps — a straggler spike is never sampled away from the
+    max, only from the quantile sample.
+    """
+
+    def __init__(self, capacity: int = 2048):
+        self._cap = max(capacity, 1)
+        self._sample: list[float] = []
+        self._rng = random.Random(0xC0FFEE)
+        self.count = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+
+    def record(self, dt_s: float):
+        if dt_s < 0:
+            return
+        self.count += 1
+        self.total_s += dt_s
+        if dt_s > self.max_s:
+            self.max_s = dt_s
+        if len(self._sample) < self._cap:
+            self._sample.append(dt_s)
+        else:
+            j = self._rng.randrange(self.count)
+            if j < self._cap:
+                self._sample[j] = dt_s
+
+    @staticmethod
+    def _nearest_rank(sorted_sample: list[float], q: float) -> float:
+        idx = max(0, min(len(sorted_sample) - 1,
+                         math.ceil(q / 100.0 * len(sorted_sample)) - 1))
+        return sorted_sample[idx]
+
+    def percentile(self, q: float) -> float:
+        """Nearest-rank percentile over the sample (exact when the run is
+        shorter than the reservoir)."""
+        if not self._sample:
+            return 0.0
+        return self._nearest_rank(sorted(self._sample), q)
+
+    def summary(self) -> dict:
+        if not self.count:
+            return {}
+        s = sorted(self._sample)  # one sort for all three percentiles
+        return {
+            "n": self.count,
+            "mean_s": round(self.total_s / self.count, 6),
+            "p50_s": round(self._nearest_rank(s, 50), 6),
+            "p95_s": round(self._nearest_rank(s, 95), 6),
+            "p99_s": round(self._nearest_rank(s, 99), 6),
+            "max_s": round(self.max_s, 6),
+        }
+
+    def reset(self):
+        self._sample = []
+        self._rng = random.Random(0xC0FFEE)
+        self.count = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+
+
+# Process-wide accumulator (the run_stats pattern): every meter also records
+# here, so bench.py workers can report step-time percentiles for whatever
+# trained in-process without threading meter objects through.
+global_step_stats = StepTimeStats()
 
 
 @dataclass
@@ -94,10 +204,23 @@ class ThroughputMeter:
     controls ``block_until_ready`` discipline — metering must not force extra
     host syncs on the hot path, so by default only every ``sync_every`` steps
     block).
+
+    Step-time caveat (applies to ``step_stats`` and the derived MFU): the
+    recorded dt is host wall time between ``update`` calls, never forcing
+    a sync. On an async backend with fit()'s default cadence, most
+    intervals are dispatch-scale and the ``log_every``-boundary interval
+    absorbs the queued compute — so ``mean_s`` (and thus MFU, which uses
+    it) is honest over any sync-bounded window, while p50/p95/p99 describe
+    the *host-observed* cadence, not the device step distribution. For
+    true per-step device latency use bench.py's fetch-closed protocol.
     """
     n_chips: int = 1
     warmup_steps: int = 1  # first step includes XLA compile; exclude it
+    flops_per_step: float | None = None  # GLOBAL per-step FLOPs (for MFU)
+    peak_flops_per_chip: float | None = None  # default: device table / env
+    step_stats: StepTimeStats = field(default_factory=StepTimeStats)
     _t0: float | None = None
+    _last_t: float | None = None
     _steps: int = 0
     _examples: int = 0
     _window: list = field(default_factory=list)
@@ -107,8 +230,14 @@ class ThroughputMeter:
         self._steps += 1
         if self._steps <= self.warmup_steps:
             self._t0 = now
+            self._last_t = now
             return
         self._examples += n_examples
+        if self._last_t is not None:
+            dt = now - self._last_t
+            self.step_stats.record(dt)
+            global_step_stats.record(dt)
+        self._last_t = now
         self._window.append((now, n_examples))
         if len(self._window) > 50:
             self._window.pop(0)
@@ -133,7 +262,25 @@ class ThroughputMeter:
         n = sum(n for _, n in self._window[1:])
         return n / dt if dt > 0 else 0.0
 
+    def _mfu_from(self, step_summary: dict) -> float | None:
+        if not self.flops_per_step:
+            return None
+        peak = self.peak_flops_per_chip or peak_flops_per_chip()
+        if not peak or not step_summary or step_summary["mean_s"] <= 0:
+            return None
+        return self.flops_per_step / step_summary["mean_s"] / (
+            peak * max(self.n_chips, 1))
+
+    def mfu(self) -> float | None:
+        """Model FLOPs utilization: achieved FLOPs/s over hardware peak.
+        Needs a per-step FLOP count (user-supplied or XLA cost-analysis
+        estimated — see ``fit(flops_per_step=...)``) and a known peak;
+        None otherwise, so consumers can tell "unknown" from "terrible"."""
+        return self._mfu_from(self.step_stats.summary())
+
     def summary(self) -> dict:
+        st = self.step_stats.summary()  # computed once for mfu + report
+        mfu = self._mfu_from(st)
         return {
             "steps": self._steps,
             "examples": self._examples,
@@ -141,6 +288,8 @@ class ThroughputMeter:
             "examples_per_sec_per_chip":
                 round(self.examples_per_sec_per_chip(), 2),
             "n_chips": self.n_chips,
+            "step_time": st or None,
+            "mfu": round(mfu, 4) if mfu is not None else None,
         }
 
 
@@ -160,32 +309,88 @@ class MetricsLogger:
     def log(self, step: int, metrics: dict):
         """Emit to TB and the text log. Cadence is the caller's job (fit()
         gates on log_every) — no re-gating here, or final/eval metrics at
-        off-cadence steps would be silently dropped."""
+        off-cadence steps would be silently dropped. Non-numeric values
+        (strings, multi-element arrays) pass through to the text line
+        instead of crashing the train loop."""
         if self._tb is not None:
             for k, v in metrics.items():
                 try:
                     self._tb.add_scalar(k, float(v), step)
                 except (TypeError, ValueError):
                     pass
-        flat = {k: (round(float(v), 5)
-                    if isinstance(v, (int, float)) or hasattr(v, "item")
-                    else v) for k, v in metrics.items()}
+
+        def _fmt(v):
+            if isinstance(v, (int, float)) or hasattr(v, "item"):
+                try:
+                    return round(float(v), 5)
+                except (TypeError, ValueError):
+                    return str(v)  # e.g. a multi-element array
+            return v
+
+        flat = {k: _fmt(v) for k, v in metrics.items()}
         log.info("step %d %s", step, json.dumps(flat, default=str))
 
+    def log_summary(self, step: int, summary: dict):
+        """Flatten a ``meter.summary()`` (nested ``step_time`` block) into
+        scalars and emit once — percentiles and MFU land in TB/text next
+        to the per-step series."""
+        flat: dict = {}
+        for k, v in summary.items():
+            if isinstance(v, dict):
+                flat.update({f"{k}_{k2}": v2 for k2, v2 in v.items()})
+            elif v is not None:
+                flat[k] = v
+        self.log(step, flat)
+
     def close(self):
-        if self._tb is not None:
-            self._tb.close()
+        """Idempotent: fit() closes on the success path and callers close
+        again in their own cleanup."""
+        tb, self._tb = self._tb, None
+        if tb is not None:
+            tb.close()
+
+
+def start_profiler_trace(log_dir: str):
+    """Start a jax profiler trace + the flight-recorder event linking
+    postmortems to the profile on disk. Pair with
+    :func:`stop_profiler_trace` (or use the :func:`trace` context
+    manager)."""
+    events.event("profile_trace", trace_dir=log_dir)
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_profiler_trace(failed: bool = False):
+    """The ONE implementation of the guarded profiler stop: if the traced
+    region already ``failed``, a ``stop_trace`` error (a region that died
+    mid-trace can leave the profiler in a state stop rejects) is logged,
+    not raised — a profiling hiccup must never mask the real failure. On
+    a clean region the stop error propagates."""
+    try:
+        jax.profiler.stop_trace()
+    except Exception:
+        if not failed:
+            raise
+        log.warning("profiler stop failed during exception unwind",
+                    exc_info=True)
 
 
 @contextlib.contextmanager
 def trace(log_dir: str):
     """Profile a region to a TensorBoard-viewable trace:
-    ``with runner.trace("/tmp/tb"): run_steps()``."""
-    jax.profiler.start_trace(log_dir)
+    ``with runner.trace("/tmp/tb"): run_steps()``.
+
+    The profiler is closed even when the region raises, without the stop
+    masking the region's own exception (see :func:`stop_profiler_trace`).
+    """
+    start_profiler_trace(log_dir)
+    failed = False
     try:
         yield
+    except BaseException:
+        failed = True
+        raise
     finally:
-        jax.profiler.stop_trace()
+        stop_profiler_trace(failed)
 
 
 def step_annotation(step: int):

@@ -34,6 +34,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core import runtime
 from . import chaos
+from . import events
 from . import metrics as metrics_lib
 from .checkpoint import CheckpointManager
 from .failures import TrainingDivergedError
@@ -174,6 +175,8 @@ class RunnerContext:
         return self._ckpt
 
     def trace(self, log_dir: str | None = None):
+        # metrics.trace emits the flight-recorder event carrying the trace
+        # dir, so a postmortem's event tail links to the profile on disk.
         return metrics_lib.trace(log_dir or (self.log_dir or "/tmp/sparkdl_tb"))
 
     def meter(self, warmup_steps: int = 1) -> metrics_lib.ThroughputMeter:
@@ -191,7 +194,8 @@ class RunnerContext:
             log_every: int = 10, explicit_collectives: bool = False,
             resume: bool = True, profile_dir: str | None = None,
             remat: bool = False, accum_steps: int = 1,
-            feed_lookahead: int | None = None) -> dict:
+            feed_lookahead: int | None = None,
+            flops_per_step: float | None = None) -> dict:
         """Run a full training loop; returns {state, meter, history}.
 
         Streams ``data`` (iterator of host-numpy batch dicts), shards each
@@ -218,6 +222,16 @@ class RunnerContext:
         on the ERROR path should keep the default inline feed (the
         exactly-where-the-inline-feed-leaves-it guarantee holds only on
         normal completion / StopIteration).
+
+        The loop is flight-recorded (``runner.events``): per-step
+        ``data_fetch``/``shard_put``/``step_compute`` spans, checkpoint and
+        eval spans, a ``compile`` event from first-step timing, and — on
+        any failure — a crash postmortem carrying the last events plus the
+        exception. Ring-buffer only (no I/O, no host sync) unless
+        ``SPARKDL_EVENT_DIR`` is set. ``flops_per_step`` (GLOBAL FLOPs per
+        step) feeds the meter's MFU; leave None and set
+        ``SPARKDL_MFU_ESTIMATE=1`` to ask XLA's cost analysis instead (one
+        extra host-side trace at startup).
         """
         state = TrainState.create(apply_fn or (lambda p, x: p), params, tx,
                                   model_state=model_state)
@@ -237,7 +251,12 @@ class RunnerContext:
             mutable=mutable, with_rng=with_rng, remat=remat,
             accum_steps=accum_steps)
         meter = self.meter()
+        meter.flops_per_step = flops_per_step
+        estimate_flops = (flops_per_step is None
+                          and _env_flag("SPARKDL_MFU_ESTIMATE"))
         logger = metrics_lib.MetricsLogger(self.log_dir)
+        events.event("fit_start", start_step=start_step,
+                     num_steps=num_steps, n_chips=self.size)
         eval_step = self.make_eval_step(eval_fn) if eval_fn else None
         history: list[dict] = []
 
@@ -294,8 +313,10 @@ class RunnerContext:
             never consume input the step loop won't run (a reused
             iterator must sit exactly where the inline feed leaves it)."""
             def _one(batch):
-                return (len(jax.tree_util.tree_leaves(batch)[0]),
-                        self.shard_batch(batch))
+                n = len(jax.tree_util.tree_leaves(batch)[0])
+                with events.span("shard_put"):
+                    sharded = self.shard_batch(batch)
+                return (n, sharded)
 
             def _cropped():
                 """Draw-on-demand: nothing is pulled from data_it past
@@ -303,7 +324,12 @@ class RunnerContext:
                 produced = 0
                 while produced < limit:
                     try:
-                        batch = next(data_it)
+                        # The span closes on StopIteration too, marking
+                        # end_of_data in the trace before the except
+                        # swallows it (PEP 479: it must not escape here).
+                        with events.span("data_fetch",
+                                         step=start_step + produced):
+                            batch = next(data_it)
                     except StopIteration:
                         return
                     batch = _crop(batch)
@@ -329,8 +355,10 @@ class RunnerContext:
 
         staged_it = _staged(num_steps - start_step)
         if profile_dir:
-            jax.profiler.start_trace(profile_dir)
+            metrics_lib.start_profiler_trace(profile_dir)
         last_m = None
+        i = start_step
+        failed = False
         try:
             for i in range(start_step, num_steps):
                 # Per-step fault-injection hook (no-op without a plan).
@@ -339,12 +367,25 @@ class RunnerContext:
                     n_local, sharded = next(staged_it)
                 except StopIteration:
                     break
+                if estimate_flops:
+                    estimate_flops = False
+                    meter.flops_per_step = _estimate_step_flops(
+                        step_fn, state, sharded)
+                    events.event("flops_estimate",
+                                 flops=meter.flops_per_step)
                 # Multi-process: `data` yields LOCAL shards (shard_batch
                 # contract) — the global step consumed n * process_count
                 # examples, and per-chip rates divide by GLOBAL chip count.
                 n = n_local * self.num_processes
-                with metrics_lib.step_annotation(i):
+                with metrics_lib.step_annotation(i), \
+                        events.span("step_compute", step=i) as sp:
                     state, m = step_fn(state, sharded)
+                if i == start_step:
+                    # First-step wall time is dominated by XLA
+                    # trace+compile (dispatch of a compiled step returns
+                    # in microseconds) — record it as the compile cost.
+                    events.event("compile", step=i,
+                                 dur_s=round(sp.seconds, 6))
                 # Liveness beacon for the gang supervisor's hang watchdog
                 # (no-op unless SPARKDL_HEARTBEAT_DIR is set). AFTER the
                 # step call, not before it: a rank becomes watchdog-
@@ -376,14 +417,30 @@ class RunnerContext:
                     self.checkpoints.save(i + 1, state)
                 if eval_step and eval_every and (i + 1) % eval_every == 0 \
                         and eval_data is not None:
-                    evm = _run_eval(eval_step, state, eval_data,
-                                    self.shard_batch)
+                    with events.span("eval", step=i + 1):
+                        evm = _run_eval(eval_step, state, eval_data,
+                                        self.shard_batch)
                     logger.log(i + 1, {f"eval_{k}": v for k, v in evm.items()})
+        except BaseException as e:
+            failed = True
+            # Crash postmortem (ISSUE 2 tentpole): the ring tail + the
+            # exception, flushed to SPARKDL_EVENT_DIR when set — the gang
+            # supervisor merges these into its timeline. The marker keeps
+            # outer handlers (run_with_restarts) from overwriting this
+            # step-bearing record with a step-less one.
+            events.postmortem(e, site="fit", step=i)
+            e._sparkdl_postmortemed = True
+            raise
         finally:
             if pool is not None:
                 pool.shutdown(wait=False, cancel_futures=True)
             if profile_dir:
-                jax.profiler.stop_trace()
+                # When the loop is already unwinding, a profiler-stop
+                # failure must not replace the real training error (the
+                # supervisor would classify the wrong exception); explicit
+                # flag, not sys.exc_info() — fit() may itself be called
+                # from inside a caller's except block.
+                metrics_lib.stop_profiler_trace(failed)
             # Finalize in-flight async checkpoint saves even when the loop
             # is unwinding on a failure: the whole point of dying mid-run
             # is resuming from the last save, which must not be left
@@ -395,13 +452,56 @@ class RunnerContext:
                 except Exception:
                     log.warning("checkpoint finalize on exit failed",
                                 exc_info=True)
-        jax.block_until_ready(state.params)
-        if self.checkpoints:
-            if last_m is not None:
-                _assert_finite_loss(last_m, int(state.step))
-            self.checkpoints.save(num_steps, state, wait=True)
+        try:
+            # Finalize under the same postmortem contract as the loop: on
+            # async backends a step's error often only materializes at
+            # this block_until_ready, and the divergence guard / final
+            # save can raise too — "any failure path" includes the tail.
+            jax.block_until_ready(state.params)
+            if self.checkpoints:
+                if last_m is not None:
+                    _assert_finite_loss(last_m, int(state.step))
+                self.checkpoints.save(num_steps, state, wait=True)
+        except BaseException as e:
+            events.postmortem(e, site="fit_finalize", step=i)
+            e._sparkdl_postmortemed = True
+            raise
+        # Final telemetry: percentiles + MFU land in the logger (TB/text)
+        # and the fit_end event, next to the per-step series.
+        summary = meter.summary()
+        logger.log_summary(num_steps, summary)
+        events.event("fit_end", final_step=num_steps,
+                     steps=meter.steps, mfu=summary.get("mfu"))
         logger.close()
         return {"state": state, "meter": meter, "history": history}
+
+
+def _env_flag(name: str) -> bool:
+    """Boolean env knob: '1'/'true'/'yes' → on, everything else (incl. a
+    user's SPARKDL_MFU_ESTIMATE=0) → off. Same truth table as bench.py's
+    ``_env_flag`` — kept as two small copies because bench's driver stays
+    importable without pulling jax through this package."""
+    return os.environ.get(name, "").strip().lower() in ("1", "true", "yes")
+
+
+def _estimate_step_flops(step_fn, state, sharded) -> float | None:
+    """XLA's own FLOP count for one global step, from jit cost analysis
+    (host-side retrace only — no device work, and deliberately NO
+    ``lowered.compile()`` fallback: that would pay a full discarded AOT
+    compile, doubling startup on big models and the window the gang
+    watchdog must tolerate before the first heartbeat). None when the
+    step isn't a jit function or the backend doesn't expose the estimate
+    pre-compile; callers wanting compiled-HLO numbers pass
+    ``fit(flops_per_step=...)`` from bench's AOT path instead."""
+    try:
+        lowered = step_fn.lower(state, sharded)
+        cost = lowered.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        return float(cost.get("flops", 0.0)) or None
+    except Exception:
+        log.debug("flops estimate unavailable", exc_info=True)
+        return None
 
 
 def _assert_finite_loss(m: dict, step: int):
@@ -515,8 +615,17 @@ class XlaRunner:
                 attempt += 1
                 if (kind == "fatal" and not retry_all) \
                         or attempt > max_restarts:
+                    # Failures inside fit() already wrote a postmortem
+                    # carrying the failing step/site — do NOT overwrite it
+                    # with this step-less one; this write covers main_fn
+                    # failures outside fit.
+                    if not getattr(e, "_sparkdl_postmortemed", False):
+                        events.postmortem(e, site="run_with_restarts",
+                                          kind=kind, attempt=attempt)
                     raise
                 metrics_lib.run_stats.record_restart()
+                events.event("restart", attempt=attempt, kind=kind,
+                             error=f"{type(e).__name__}: {e}"[:300])
                 log.exception("run failed (%s); restart %d/%d", kind,
                               attempt, max_restarts)
                 time.sleep(backoff_s * attempt)

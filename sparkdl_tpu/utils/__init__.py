@@ -1,6 +1,16 @@
 """Shared utilities: pytree path helpers and device-aware timing."""
 
 from .trees import flatten_with_paths, path_str, tree_size_bytes
-from .timing import Timer
 
 __all__ = ["flatten_with_paths", "path_str", "tree_size_bytes", "Timer"]
+
+
+def __getattr__(name):
+    # Lazy: Timer is the flight recorder's span base (runner.events). The
+    # laziness is for import-cycle safety, not cost — an eager import here
+    # would re-enter the runner package while sparkdl_tpu/__init__ is
+    # mid-initialization for any consumer that reaches utils first.
+    if name == "Timer":
+        from .timing import Timer
+        return Timer
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

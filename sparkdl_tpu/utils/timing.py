@@ -1,25 +1,20 @@
-"""Wall-clock timing with device-completion awareness."""
+"""Wall-clock timing with device-completion awareness.
+
+Thin alias (ISSUE 2 satellite): the timing primitive now lives in the
+flight-recorder span API — ``sparkdl_tpu.runner.events.Timer`` is the base
+class of ``events.span``, so there is exactly one timing implementation in
+the codebase. The import is lazy (module ``__getattr__``) for import-cycle
+safety — resolving it eagerly would re-enter the runner package while the
+top-level ``sparkdl_tpu`` init is still running.
+"""
 
 from __future__ import annotations
 
-import time
+__all__ = ["Timer"]
 
 
-class Timer:
-    """``with Timer() as t: ...`` — blocks on ``block_on`` (a jax pytree)
-    before stopping, so device work is actually counted."""
-
-    def __init__(self, block_on=None):
-        self._block_on = block_on
-        self.seconds = 0.0
-
-    def __enter__(self):
-        self._t0 = time.perf_counter()
-        return self
-
-    def __exit__(self, *exc):
-        if self._block_on is not None:
-            import jax
-            jax.block_until_ready(self._block_on)
-        self.seconds = time.perf_counter() - self._t0
-        return False
+def __getattr__(name):
+    if name == "Timer":
+        from ..runner.events import Timer
+        return Timer
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

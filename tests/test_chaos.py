@@ -4,6 +4,7 @@ only *declared* — checkpoint-resume under injected preemption, fail-fast on
 divergence, heartbeat plumbing — actually execute in tier-1.
 """
 
+import json
 import os
 import subprocess
 import sys
@@ -234,7 +235,9 @@ class TestChaosThroughFit:
             num_steps=3, log_every=100))
         beat = hb / "rank0.hb"
         assert beat.exists()
-        assert beat.read_text() == "2"  # last step index the loop reached
+        body = json.loads(beat.read_text())
+        assert body["step"] == 2  # last step index the loop reached
+        assert body["time"] > 0  # wall clock rides alongside (ISSUE 2)
 
     def test_touch_heartbeat_noop_without_env(self, monkeypatch, tmp_path):
         monkeypatch.delenv("SPARKDL_HEARTBEAT_DIR", raising=False)
@@ -242,7 +245,8 @@ class TestChaosThroughFit:
         monkeypatch.setenv("SPARKDL_HEARTBEAT_DIR", str(tmp_path / "hb2"))
         monkeypatch.setenv("SPARKDL_PROCESS_ID", "3")
         touch_heartbeat(5)
-        assert (tmp_path / "hb2" / "rank3.hb").read_text() == "5"
+        body = json.loads((tmp_path / "hb2" / "rank3.hb").read_text())
+        assert body["step"] == 5
 
 
 @pytest.mark.slow
@@ -251,6 +255,20 @@ def test_chaos_smoke_script(tmp_path):
     + checkpoint resume in real subprocesses on CPU."""
     proc = subprocess.run(
         [sys.executable, os.path.join(_REPO, "scripts", "chaos_smoke.py")],
+        capture_output=True, text=True, timeout=420,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert '"ok": true' in proc.stdout, proc.stdout[-2000:]
+
+
+@pytest.mark.slow
+def test_obs_smoke_script(tmp_path):
+    """scripts/obs_smoke.py end-to-end (ISSUE 2 satellite): a real CPU fit
+    under the supervisor with the flight recorder on and one injected
+    preemption; the merged gang-timeline postmortem must name the faulted
+    rank and site."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "obs_smoke.py")],
         capture_output=True, text=True, timeout=420,
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert proc.returncode == 0, proc.stderr[-2000:]

@@ -1,0 +1,670 @@
+"""Flight recorder tests (ISSUE 2 tentpole): structured event tracing
+through the real training machinery — ring-buffer bounds, JSONL streaming,
+crash postmortems, merged gang timelines, step-time percentiles, and MFU —
+plus the observability satellites (atomic heartbeats, robust trace(),
+MetricsLogger hardening).
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from sparkdl_tpu.runner import (Fault, FaultPlan, GangFailure, StepTimeStats,
+                                ThroughputMeter, XlaRunner, chaos, events,
+                                launcher, run_stats,
+                                softmax_cross_entropy_loss, supervise)
+from sparkdl_tpu.runner import metrics as metrics_lib
+from sparkdl_tpu.runner.metrics import MetricsLogger
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    """Every test starts with a fresh recorder, no stream dir, and zeroed
+    process-wide stats."""
+    monkeypatch.delenv("SPARKDL_EVENT_DIR", raising=False)
+    monkeypatch.delenv("SPARKDL_EVENT_RING", raising=False)
+    monkeypatch.delenv("SPARKDL_PEAK_FLOPS", raising=False)
+    monkeypatch.delenv("SPARKDL_MFU_ESTIMATE", raising=False)
+    monkeypatch.delenv(chaos.CHAOS_ENV, raising=False)
+    chaos.uninstall()
+    events.reset()
+    metrics_lib.global_step_stats.reset()
+    run_stats.reset()
+    yield
+    chaos.uninstall()
+    events.reset()
+    metrics_lib.global_step_stats.reset()
+    run_stats.reset()
+
+
+def _linear_apply(params, x):
+    return x @ params["w"]
+
+
+def _params(seed=0):
+    rng = np.random.RandomState(seed)
+    return {"w": rng.randn(4, 3).astype(np.float32)}
+
+
+def _data(n_batches=64, seed=1):
+    rng = np.random.RandomState(seed)
+    for _ in range(n_batches):
+        x = rng.randn(16, 4).astype(np.float32)
+        yield {"image": x, "label": rng.randint(0, 3, (16,))}
+
+
+def _fit(ctx, **kw):
+    kw.setdefault("num_steps", 4)
+    kw.setdefault("log_every", 100)
+    return ctx.fit(loss_fn=softmax_cross_entropy_loss(), params=_params(),
+                   tx=optax.sgd(0.1), apply_fn=_linear_apply, data=_data(),
+                   **kw)
+
+
+class TestRecorder:
+    def test_ring_is_bounded(self):
+        rec = events.reset(ring_size=16)
+        for i in range(100):
+            rec.event("e", i=i)
+        tail = rec.tail()
+        assert len(tail) == 16
+        assert tail[0]["i"] == 84 and tail[-1]["i"] == 99
+
+    def test_span_records_duration_and_error(self):
+        rec = events.reset()
+        with events.span("ok", step=3):
+            time.sleep(0.002)
+        with pytest.raises(ValueError, match="boom"):
+            with events.span("bad"):
+                raise ValueError("boom")
+        ok_end = [e for e in rec.tail() if e["name"] == "ok"
+                  and e["ph"] == "E"][0]
+        assert ok_end["dur_s"] >= 0.002 and ok_end["step"] == 3
+        bad_end = [e for e in rec.tail() if e["name"] == "bad"
+                   and e["ph"] == "E"][0]
+        assert bad_end["error"] == "ValueError: boom"
+
+    def test_data_exhaustion_is_not_an_error(self):
+        """A span closed by StopIteration (fit's data_fetch around next())
+        marks end_of_data — NOT error — so a rank that merely finished its
+        data can never be named the gang's first failure."""
+        rec = events.reset()
+        it = iter([])
+        try:
+            with events.span("data_fetch", step=0):
+                next(it)
+        except StopIteration:
+            pass
+        end = rec.tail()[-1]
+        assert end["ph"] == "E" and end.get("end_of_data") is True
+        assert "error" not in end
+
+    def test_block_on_error_does_not_mask_region_error(self, monkeypatch):
+        """When the region raised AND block_until_ready also fails, the
+        region's exception is the story — the block error is recorded in
+        the end event, never raised over it (classification depends on
+        the right exception propagating)."""
+        rec = events.reset()
+        monkeypatch.setattr(jax, "block_until_ready", lambda t: (_ for _ in
+                            ()).throw(RuntimeError("UNAVAILABLE: device")))
+        with pytest.raises(ValueError, match="diverged-ish"):
+            with events.span("step", block_on=object()):
+                raise ValueError("diverged-ish user error")
+        end = rec.tail()[-1]
+        assert end["error"].startswith("ValueError")
+        assert end["block_error"].startswith("RuntimeError")
+        # clean region: the device error DOES surface
+        with pytest.raises(RuntimeError, match="UNAVAILABLE"):
+            with events.span("step", block_on=object()):
+                pass
+        assert rec.tail()[-1]["error"].startswith("RuntimeError")
+
+    def test_no_dir_means_no_io(self, tmp_path):
+        rec = events.reset()
+        for i in range(50):
+            rec.event("e", i=i)
+        assert rec._file is None  # never opened a stream
+        assert list(tmp_path.iterdir()) == []
+
+    def test_streams_jsonl_per_rank(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("SPARKDL_EVENT_DIR", str(tmp_path))
+        monkeypatch.setenv("SPARKDL_PROCESS_ID", "3")
+        rec = events.reset()
+        rec.event("alpha", step=1)
+        with rec.span("beta"):
+            pass
+        path = tmp_path / "events_rank3.jsonl"
+        recs = [json.loads(ln) for ln in path.read_text().splitlines()]
+        assert [r["name"] for r in recs] == ["alpha", "beta", "beta"]
+        assert [r["ph"] for r in recs] == ["P", "B", "E"]
+        assert all(r["rank"] == 3 for r in recs)
+        assert recs[0]["step"] == 1
+
+    def test_stream_cap_bounds_file_ring_keeps_recording(self, tmp_path,
+                                                         monkeypatch):
+        monkeypatch.setenv("SPARKDL_EVENT_DIR", str(tmp_path))
+        monkeypatch.setenv("SPARKDL_PROCESS_ID", "0")
+        monkeypatch.setenv("SPARKDL_EVENT_MAX_MB", "0.0005")  # ~520 bytes
+        rec = events.reset()
+        for i in range(100):
+            rec.event("e", i=i)
+        lines = (tmp_path / "events_rank0.jsonl").read_text().splitlines()
+        recs = [json.loads(ln) for ln in lines]
+        assert recs[-1]["name"] == "event_stream_truncated"
+        assert len(recs) < 100  # file bounded...
+        assert len(rec.tail()) > len(recs)  # ...ring kept recording
+        size = (tmp_path / "events_rank0.jsonl").stat().st_size
+        rec.event("after")  # no further growth past the marker
+        assert (tmp_path / "events_rank0.jsonl").stat().st_size == size
+
+    def test_stream_cap_survives_recorder_reset(self, tmp_path,
+                                                monkeypatch):
+        """The cap budget is seeded from the file already on disk: a
+        reset()-per-attempt retry loop must not grow the stream
+        N_attempts x cap."""
+        monkeypatch.setenv("SPARKDL_EVENT_DIR", str(tmp_path))
+        monkeypatch.setenv("SPARKDL_PROCESS_ID", "0")
+        monkeypatch.setenv("SPARKDL_EVENT_MAX_MB", "0.0005")
+        rec = events.reset()
+        for i in range(100):
+            rec.event("e", i=i)
+        size = (tmp_path / "events_rank0.jsonl").stat().st_size
+        rec2 = events.reset()  # fresh recorder, same dir, same file
+        for i in range(100):
+            rec2.event("e", i=i)
+        assert (tmp_path / "events_rank0.jsonl").stat().st_size == size
+
+    def test_enable_flight_recorder(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("SPARKDL_PROCESS_ID", "0")
+        # setenv first so monkeypatch restores the pre-test absence even
+        # though enable_flight_recorder writes os.environ directly
+        monkeypatch.setenv("SPARKDL_EVENT_DIR", "overwritten")
+        monkeypatch.setenv("SPARKDL_EVENT_RING", "overwritten")
+        from sparkdl_tpu.runner.api import enable_flight_recorder
+        rec = enable_flight_recorder(str(tmp_path), ring_size=32)
+        assert os.environ["SPARKDL_EVENT_DIR"] == str(tmp_path)
+        rec.event("hello")
+        assert (tmp_path / "events_rank0.jsonl").exists()
+        assert rec.ring.maxlen == 32
+
+    def test_timer_is_the_span_primitive(self):
+        from sparkdl_tpu.utils import Timer
+        assert Timer is events.Timer
+        with Timer() as t:
+            time.sleep(0.002)
+        assert t.seconds >= 0.002
+        # spans ARE timers — one timing primitive in the codebase
+        assert issubclass(type(events.span("x")), Timer)
+
+
+class TestStepTimeStats:
+    def test_percentiles_on_synthetic_sequence(self):
+        st = StepTimeStats()
+        for ms in range(1, 101):  # 1..100 ms
+            st.record(ms / 1000.0)
+        s = st.summary()
+        assert s["n"] == 100
+        assert s["p50_s"] == pytest.approx(0.050)
+        assert s["p95_s"] == pytest.approx(0.095)
+        assert s["p99_s"] == pytest.approx(0.099)
+        assert s["max_s"] == pytest.approx(0.100)
+        assert s["mean_s"] == pytest.approx(0.0505)
+
+    def test_reservoir_bounds_memory_keeps_max_exact(self):
+        st = StepTimeStats(capacity=50)
+        for i in range(1000):
+            st.record(0.001 * (i % 97 + 1))
+        assert len(st._sample) == 50
+        assert st.count == 1000
+        assert st.summary()["max_s"] == pytest.approx(0.097)
+        assert 0.001 <= st.percentile(50) <= 0.097
+
+    def test_meter_summary_carries_percentiles_and_mfu(self, monkeypatch):
+        m = ThroughputMeter(n_chips=4, warmup_steps=0)
+        for _ in range(10):
+            m.step_stats.record(0.1)
+        # FLOPs unknown -> MFU is null, not zero
+        assert m.summary()["mfu"] is None
+        monkeypatch.setenv("SPARKDL_PEAK_FLOPS", "1e12")
+        m.flops_per_step = 4e10  # global step over 4 chips at 1e12 peak
+        s = m.summary()
+        # 4e10 / 0.1s / (1e12 * 4 chips) = 0.1
+        assert s["mfu"] == pytest.approx(0.1)
+        assert s["step_time"]["p50_s"] == pytest.approx(0.1)
+
+    def test_fit_populates_step_time(self):
+        res = XlaRunner(np=8).run(_fit)
+        s = res["meter"].summary()
+        assert s["step_time"]["n"] == 3  # 4 steps - 1 warmup
+        assert s["step_time"]["p99_s"] >= s["step_time"]["p50_s"] > 0
+        assert s["mfu"] is None  # no FLOP count supplied
+        # the process-wide reservoir (bench's source) saw the same steps
+        assert metrics_lib.global_step_stats.count == 3
+
+    def test_fit_mfu_estimate_via_cost_analysis(self, monkeypatch):
+        monkeypatch.setenv("SPARKDL_MFU_ESTIMATE", "1")
+        monkeypatch.setenv("SPARKDL_PEAK_FLOPS", "1e12")
+        res = XlaRunner(np=8).run(_fit)
+        m = res["meter"]
+        assert m.flops_per_step is not None and m.flops_per_step > 0
+        assert m.summary()["mfu"] is not None
+
+
+class TestPostmortem:
+    def test_fit_failure_writes_postmortem(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("SPARKDL_EVENT_DIR", str(tmp_path))
+        monkeypatch.setenv("SPARKDL_PROCESS_ID", "0")
+        events.reset()
+        chaos.install(FaultPlan([Fault("step_start", "preempt", at_step=2)]))
+        with pytest.raises(Exception, match="UNAVAILABLE"):
+            XlaRunner(np=8).run(_fit)
+        pm = json.loads((tmp_path / "postmortem_rank0.json").read_text())
+        assert pm["error"]["type"] == "InjectedPreemption"
+        assert pm["error"]["kind"] == "retryable"
+        assert pm["site"] == "fit" and pm["step"] == 2
+        names = [e["name"] for e in pm["events"]]
+        assert "fit_start" in names and "chaos" in names
+        assert "step_compute" in names and "compile" in names
+        # the stream holds the same trail (flushed line-by-line)
+        lines = (tmp_path / "events_rank0.jsonl").read_text().splitlines()
+        assert any(json.loads(ln)["name"] == "chaos" for ln in lines)
+
+    def test_chaos_fire_lands_in_trace(self):
+        rec = events.reset()
+        chaos.install(FaultPlan([Fault("batch_fetch", "nan", at_step=0)]))
+        chaos.fire("batch_fetch", step=0,
+                   batch={"x": np.ones(3, np.float32)})
+        ev = [e for e in rec.tail() if e["name"] == "chaos"]
+        assert ev and ev[0]["site"] == "batch_fetch" \
+            and ev[0]["kind"] == "nan" and ev[0]["step"] == 0
+
+
+class TestOverheadBounded:
+    def test_recorder_off_is_ring_only_no_sync(self, tmp_path, monkeypatch):
+        """Acceptance: with SPARKDL_EVENT_DIR unset, a recorded fit() does
+        no event I/O and introduces no extra host syncs — exactly the one
+        pre-existing block_until_ready at the end of fit()."""
+        rec = events.reset()
+        calls = []
+        orig = jax.block_until_ready
+        monkeypatch.setattr(
+            jax, "block_until_ready",
+            lambda tree: (calls.append(1), orig(tree))[1])
+        res = XlaRunner(np=8).run(_fit)
+        assert int(res["state"].step) == 4
+        assert len(calls) == 1  # fit()'s final sync only
+        assert rec._file is None  # no stream was ever opened
+        assert list(tmp_path.iterdir()) == []
+        assert any(e["name"] == "step_compute" for e in rec.tail())
+
+
+class TestMergeTimeline:
+    def _write(self, d, rank, recs):
+        with open(os.path.join(d, f"events_rank{rank}.jsonl"), "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+
+    def test_merged_order_and_first_failure(self, tmp_path):
+        d = str(tmp_path)
+        self._write(d, 0, [
+            {"t": 100.0, "name": "step_compute", "ph": "B", "rank": 0,
+             "step": 0},
+            {"t": 101.0, "name": "step_compute", "ph": "E", "rank": 0,
+             "step": 1},
+            {"t": 102.0, "name": "step_compute", "ph": "E", "rank": 0,
+             "step": 2},
+        ])
+        self._write(d, 1, [
+            {"t": 100.1, "name": "step_compute", "ph": "E", "rank": 1,
+             "step": 0},
+            {"t": 100.6, "name": "chaos", "ph": "P", "rank": 1,
+             "site": "step_start", "kind": "preempt", "step": 1},
+        ])
+        with open(os.path.join(d, "postmortem_rank1.json"), "w") as f:
+            json.dump({"t": 100.7, "rank": 1, "site": "fit", "step": 1,
+                       "error": {"type": "InjectedPreemption",
+                                 "kind": "retryable",
+                                 "message": "UNAVAILABLE: injected"}}, f)
+        hb = tmp_path / "hb"
+        hb.mkdir()
+        (hb / "rank0.hb").write_text(json.dumps({"step": 2, "time": 102.0}))
+        tl = events.merge_timeline(d, heartbeat_dir=str(hb))
+        assert tl["first_failing_rank"] == 1
+        assert tl["first_failure"]["site"] == "step_start"
+        assert tl["first_failure"]["step"] == 1
+        assert tl["ranks"]["1"]["last_step"] == 1
+        assert tl["ranks"]["0"]["last_step"] == 2
+        assert tl["ranks"]["0"]["heartbeat"]["step"] == 2
+        assert tl["first_stalled_rank"] == 1  # its trace ends earliest
+        ts = [e["t"] for e in tl["events"]]
+        assert ts == sorted(ts)  # one merged, time-ordered stream
+        text = events.format_timeline(tl)
+        assert "rank 1" in text and "step_start" in text
+
+    def test_finished_rank_does_not_mask_real_failure(self, tmp_path):
+        """Regression: rank 0 exhausts its data (end_of_data) BEFORE rank 1
+        faults — the later, real fault must still be the first failure."""
+        d = str(tmp_path)
+        self._write(d, 0, [
+            {"t": 100.0, "name": "data_fetch", "ph": "E", "rank": 0,
+             "step": 5, "end_of_data": True, "dur_s": 0.001},
+        ])
+        self._write(d, 1, [
+            {"t": 101.0, "name": "chaos", "ph": "P", "rank": 1,
+             "site": "step_start", "kind": "preempt", "step": 4},
+        ])
+        tl = events.merge_timeline(d)
+        assert tl["first_failing_rank"] == 1
+        assert tl["first_failure"]["site"] == "step_start"
+
+    def test_recovered_restart_does_not_outrank_terminal_fault(
+            self, tmp_path):
+        """An in-process restart RECOVERED from its error — the later
+        fault that actually killed the gang must be the first failure."""
+        d = str(tmp_path)
+        self._write(d, 0, [
+            {"t": 100.0, "name": "restart", "ph": "P", "rank": 0,
+             "attempt": 1, "kind": "retryable",
+             "error": "XlaRuntimeError: UNAVAILABLE (recovered)"},
+            {"t": 150.0, "name": "step_compute", "ph": "E", "rank": 0,
+             "step": 40, "dur_s": 0.01},
+        ])
+        self._write(d, 1, [
+            {"t": 140.0, "name": "chaos", "ph": "P", "rank": 1,
+             "site": "step_start", "kind": "fatal", "step": 30},
+        ])
+        tl = events.merge_timeline(d)
+        assert tl["first_failing_rank"] == 1
+        assert tl["first_failure"]["site"] == "step_start"
+        # ...but with no terminal evidence, the recovered error is named
+        os.unlink(os.path.join(d, "events_rank1.jsonl"))
+        tl = events.merge_timeline(d)
+        assert tl["first_failing_rank"] == 0
+        assert tl["first_failure"].get("recovered") is True
+
+    def test_recovered_attempts_chaos_evidence_is_demoted_too(
+            self, tmp_path):
+        """Not just the restart event: the recovered attempt's own chaos/
+        span-error evidence precedes its restart and must rank below the
+        fault that killed the gang."""
+        d = str(tmp_path)
+        self._write(d, 0, [
+            {"t": 100.0, "name": "chaos", "ph": "P", "rank": 0,
+             "site": "step_start", "kind": "preempt", "step": 3},
+            {"t": 100.5, "name": "step_compute", "ph": "E", "rank": 0,
+             "step": 3, "dur_s": 0.01,
+             "error": "InjectedPreemption: UNAVAILABLE"},
+            {"t": 101.0, "name": "restart", "ph": "P", "rank": 0,
+             "attempt": 1, "kind": "retryable",
+             "error": "InjectedPreemption: UNAVAILABLE"},
+        ])
+        self._write(d, 1, [
+            {"t": 140.0, "name": "chaos", "ph": "P", "rank": 1,
+             "site": "step_start", "kind": "fatal", "step": 30},
+        ])
+        tl = events.merge_timeline(d)
+        assert tl["first_failing_rank"] == 1
+        assert tl["first_failure"]["step"] == 30
+        assert "recovered" not in tl["first_failure"]
+
+    def test_hang_outranks_recovered_error_for_attribution(self, tmp_path):
+        """A rank that RECOVERED its error must not be blamed for a later
+        hang on another rank: with no terminal evidence, the stall
+        heuristic names the rank that went quiet."""
+        d = str(tmp_path)
+        self._write(d, 0, [  # hangs after step 5 — goes quiet at t=150
+            {"t": 150.0, "name": "step_compute", "ph": "E", "rank": 0,
+             "step": 5, "dur_s": 0.01},
+        ])
+        self._write(d, 1, [  # recovered at t=100, kept training to t=190
+            {"t": 100.0, "name": "restart", "ph": "P", "rank": 1,
+             "attempt": 1, "kind": "retryable",
+             "error": "XlaRuntimeError: UNAVAILABLE (recovered)"},
+            {"t": 190.0, "name": "step_compute", "ph": "E", "rank": 1,
+             "step": 30, "dur_s": 0.01},
+        ])
+        tl = events.merge_timeline(d)
+        assert tl["first_failing_rank"] == 0  # the hung rank, not rank 1
+        assert tl["first_stalled_rank"] == 0
+        text = events.format_timeline(tl)
+        assert "rank 0 stalled first" in text
+        assert "recovered in-process" in text  # narrative, not blame
+
+    def test_stall_pick_consults_heartbeats(self, tmp_path):
+        """A rank whose event stream froze (size cap / never streamed) but
+        whose heartbeat is fresh must not be blamed as first-stalled."""
+        d = str(tmp_path)
+        self._write(d, 0, [{"t": 100.0, "name": "step_compute", "ph": "E",
+                            "rank": 0, "step": 1, "dur_s": 0.01}])
+        self._write(d, 1, [{"t": 200.0, "name": "step_compute", "ph": "E",
+                            "rank": 1, "step": 50, "dur_s": 0.01}])
+        hb = tmp_path / "hb"
+        hb.mkdir()
+        # rank 0 kept beating long after its trace froze; rank 1 went
+        # silent at t=200 with no heartbeat at all
+        (hb / "rank0.hb").write_text(
+            json.dumps({"step": 300, "time": 500.0}))
+        tl = events.merge_timeline(d, heartbeat_dir=str(hb))
+        assert tl["first_stalled_rank"] == 1
+
+    def test_empty_dir_yields_no_ranks(self, tmp_path):
+        tl = events.merge_timeline(str(tmp_path))
+        assert tl["ranks"] == {} and tl["first_failing_rank"] is None
+
+    def test_clear_rank_files_globs_all_ranks(self, tmp_path):
+        """A reused event dir from an earlier, LARGER gang must not leak a
+        stale high-rank trace into the next attempt's timeline."""
+        d = str(tmp_path)
+        self._write(d, 7, [{"t": 1.0, "name": "chaos", "ph": "P",
+                            "rank": 7, "site": "worker",
+                            "kind": "fatal"}])
+        (tmp_path / "postmortem_rank7.json").write_text("{}")
+        events.clear_rank_files(d)  # rank 7 cleared (glob, not 0..np-1)
+        assert list(tmp_path.iterdir()) == []
+
+    def test_last_step_ignores_prefetch_feed_events(self, tmp_path):
+        """feed_lookahead: data_fetch spans run steps AHEAD of compute —
+        the timeline must report the last step the rank actually computed,
+        not the feed position."""
+        d = str(tmp_path)
+        self._write(d, 0, [
+            {"t": 1.0, "name": "step_compute", "ph": "E", "rank": 0,
+             "step": 10, "dur_s": 0.01},
+            {"t": 1.1, "name": "data_fetch", "ph": "E", "rank": 0,
+             "step": 14, "dur_s": 0.001},  # prefetcher, 4 steps ahead
+        ])
+        tl = events.merge_timeline(d)
+        assert tl["ranks"]["0"]["last_step"] == 10
+
+    def test_clear_rank_files_removes_stale_gang_timeline(self, tmp_path):
+        (tmp_path / events.GANG_TIMELINE_FILE).write_text("{}")
+        events.clear_rank_files(str(tmp_path))
+        assert list(tmp_path.iterdir()) == []
+
+    def test_torn_tail_line_is_skipped(self, tmp_path):
+        d = str(tmp_path)
+        with open(os.path.join(d, "events_rank0.jsonl"), "w") as f:
+            f.write(json.dumps({"t": 1.0, "name": "a", "ph": "P",
+                                "rank": 0, "step": 5}) + "\n")
+            f.write('{"t": 2.0, "name": "tru')  # SIGKILL mid-write
+        tl = events.merge_timeline(d)
+        assert tl["ranks"]["0"]["n_events"] == 1
+        assert tl["ranks"]["0"]["last_step"] == 5
+
+
+_TIMELINE_WORKER = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+from sparkdl_tpu.runner import chaos, events
+rank = int(os.environ["SPARKDL_PROCESS_ID"])
+for step in range(4):
+    with events.span("step_compute", step=step):
+        try:
+            chaos.fire("step_start", step=step)
+        except Exception as e:
+            events.postmortem(e, site="step_start", step=step)
+            raise
+        time.sleep(0.05)
+time.sleep(60)  # survivor: wait for the gang kill
+"""
+
+
+class TestGangTimeline:
+    def test_supervise_failure_carries_merged_timeline(self, tmp_path):
+        """Acceptance: a chaos-injected gang failure under supervise()
+        produces a merged, time-ordered gang-timeline postmortem naming
+        the first-failing rank, its last step, and the fault site."""
+        script = tmp_path / "w.py"
+        script.write_text(_TIMELINE_WORKER.format(repo=_REPO))
+        event_dir = tmp_path / "events"
+        plan = FaultPlan([Fault("step_start", "preempt", at_step=2,
+                                rank=1)])
+        with pytest.raises(GangFailure) as ei:
+            supervise(str(script), np=2, timeout_s=120.0, max_restarts=0,
+                      backoff_s=0.05, poll_s=0.25, plan=plan,
+                      event_dir=str(event_dir))
+        err = ei.value
+        assert err.timeline is not None
+        assert err.timeline["first_failing_rank"] == 1
+        assert err.timeline["first_failure"]["site"] == "step_start"
+        assert err.timeline["first_failure"]["step"] == 2
+        assert err.timeline["ranks"]["1"]["last_step"] == 2
+        ts = [e["t"] for e in err.timeline["events"]]
+        assert ts == sorted(ts)
+        # written next to the salvaged stderr, and named in the message
+        merged = event_dir / events.GANG_TIMELINE_FILE
+        assert merged.exists()
+        assert json.loads(merged.read_text())["first_failing_rank"] == 1
+        assert "gang timeline" in str(err)
+        assert "first failure on rank 1" in str(err)
+
+
+class TestGangEventDirIsolation:
+    def test_supervise_does_not_clobber_driver_event_stream(
+            self, tmp_path, monkeypatch):
+        """A driver with its own recorder streaming to SPARKDL_EVENT_DIR
+        must keep its events_rank0.jsonl across supervise(): the gang gets
+        a subdir, so per-attempt clearing can't unlink the driver's live
+        file or conflate driver events with worker rank 0's."""
+        monkeypatch.setenv("SPARKDL_EVENT_DIR", str(tmp_path))
+        monkeypatch.setenv("SPARKDL_PROCESS_ID", "0")
+        rec = events.reset()
+        rec.event("driver_alive")
+        script = tmp_path / "w.py"
+        script.write_text("import sys; sys.exit(1)\n")
+        with pytest.raises(GangFailure):
+            supervise(str(script), np=1, timeout_s=30.0, max_restarts=0,
+                      backoff_s=0.05, poll_s=0.25)
+        rec.event("driver_still_alive")
+        lines = (tmp_path / "events_rank0.jsonl").read_text().splitlines()
+        assert [json.loads(ln)["name"] for ln in lines] == \
+            ["driver_alive", "driver_still_alive"]
+        # the gang ran in its own unique subdir namespace — and since the
+        # jax-free worker streamed nothing, the empty dir was pruned on
+        # the give-up path rather than left as clutter
+        assert not any(p.name.startswith("gang-")
+                       for p in tmp_path.iterdir() if p.is_dir())
+
+
+class TestHeartbeatSatellite:
+    def test_touch_heartbeat_is_atomic_json(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("SPARKDL_HEARTBEAT_DIR", str(tmp_path))
+        monkeypatch.setenv("SPARKDL_PROCESS_ID", "2")
+        t0 = time.time()
+        metrics_lib.touch_heartbeat(7)
+        body = json.loads((tmp_path / "rank2.hb").read_text())
+        assert body["step"] == 7
+        assert t0 - 1 <= body["time"] <= time.time() + 1
+        # no tmp droppings left behind (the os.replace committed)
+        assert [p.name for p in tmp_path.iterdir()] == ["rank2.hb"]
+
+    def test_watchdog_parses_json_and_legacy_bodies(self, tmp_path):
+        (tmp_path / "rank0.hb").write_text(
+            json.dumps({"step": 12, "time": 1.0}))
+        (tmp_path / "rank1.hb").write_text("34")  # pre-PR-2 bare body
+        ages = launcher._heartbeat_ages(str(tmp_path), 2, time.time())
+        assert ages[0][1] == "12"
+        assert ages[1][1] == "34"
+
+
+class TestMetricsLoggerSatellite:
+    def test_tb_unavailable_falls_back_to_log(self, tmp_path, monkeypatch,
+                                              caplog):
+        monkeypatch.setitem(sys.modules, "tensorboardX", None)
+        logger = MetricsLogger(str(tmp_path / "tb"))
+        assert logger._tb is None  # fell back without raising
+        with caplog.at_level("INFO", logger="sparkdl_tpu.runner"):
+            logger.log(1, {"loss": 0.5})
+        assert "loss" in caplog.text
+        logger.close()
+
+    def test_non_numeric_values_do_not_crash(self, caplog):
+        logger = MetricsLogger(None)
+        with caplog.at_level("INFO", logger="sparkdl_tpu.runner"):
+            logger.log(2, {"loss": np.float32(1.5), "note": "warmup",
+                           "arr": np.ones(3)})  # .item-bearing, not scalar
+        assert "warmup" in caplog.text
+        logger.close()
+
+    def test_close_is_idempotent(self, tmp_path):
+        logger = MetricsLogger(str(tmp_path / "tb"))
+        logger.close()
+        logger.close()  # second close must be a no-op
+        assert logger._tb is None
+        logger.log(1, {"loss": 1.0})  # and logging still works (text path)
+
+    def test_log_summary_flattens_nested_blocks(self, caplog):
+        logger = MetricsLogger(None)
+        with caplog.at_level("INFO", logger="sparkdl_tpu.runner"):
+            logger.log_summary(10, {"examples_per_sec": 5.0, "mfu": None,
+                                    "step_time": {"p50_s": 0.1}})
+        assert "step_time_p50_s" in caplog.text
+        assert "mfu" not in caplog.text  # None dropped, not logged as null
+        logger.close()
+
+
+class TestTraceSatellite:
+    def test_region_failure_still_stops_profiler(self, monkeypatch):
+        calls = []
+        monkeypatch.setattr(jax.profiler, "start_trace",
+                            lambda d: calls.append("start"))
+
+        def stop():
+            calls.append("stop")
+            raise RuntimeError("No profiler session running")
+
+        monkeypatch.setattr(jax.profiler, "stop_trace", stop)
+        # failed region: stop IS attempted, its error does not mask ours
+        with pytest.raises(ValueError, match="user bug"):
+            with metrics_lib.trace("/tmp/x"):
+                raise ValueError("user bug")
+        assert calls == ["start", "stop"]
+
+    def test_stop_error_propagates_when_region_succeeded(self, monkeypatch):
+        monkeypatch.setattr(jax.profiler, "start_trace", lambda d: None)
+
+        def stop():
+            raise RuntimeError("profiler broke")
+
+        monkeypatch.setattr(jax.profiler, "stop_trace", stop)
+        with pytest.raises(RuntimeError, match="profiler broke"):
+            with metrics_lib.trace("/tmp/x"):
+                pass
+
+    def test_trace_emits_event_with_dir(self, monkeypatch):
+        rec = events.reset()
+        monkeypatch.setattr(jax.profiler, "start_trace", lambda d: None)
+        monkeypatch.setattr(jax.profiler, "stop_trace", lambda: None)
+        ctx = XlaRunner(np=8).make_context()
+        with ctx.trace("/tmp/sparkdl_trace_test"):
+            pass
+        ev = [e for e in rec.tail() if e["name"] == "profile_trace"]
+        assert ev and ev[0]["trace_dir"] == "/tmp/sparkdl_trace_test"
