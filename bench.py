@@ -426,12 +426,24 @@ def _worker_featurizer() -> dict:
     # Warmup: param init + XLA compile on a small slice.
     feat.transform(make_df(batch)).collect()
 
+    # Per-stage engine telemetry for the timed run: the streaming scorer
+    # spans every stage (decode/pad/put/dispatch/fetch/encode), so the
+    # record shows WHERE inference wall time goes, not just the rate.
+    from sparkdl_tpu.core.runtime import decode_workers_default
+    from sparkdl_tpu.runner import events as events_lib
+    rec = events_lib.reset(ring_size=65536)
     df = make_df(rows)
     t0 = time.perf_counter()
     out = feat.transform(df).collect()
     dt = time.perf_counter() - t0
     assert len(out) == rows
     assert len(out[0]["features"]) == feat.featureDim()
+    stage_seconds: dict = {}
+    for e in rec.tail():
+        if e.get("ph") == "E" and "dur_s" in e:
+            stage_seconds[e["name"]] = round(
+                stage_seconds.get(e["name"], 0.0) + e["dur_s"], 4)
+    events_lib.reset()
 
     # A/B: same transform with 4 concurrent transfer threads
     # (SPARKDL_TRANSFER_WORKERS) — on the axon tunnel device_put holds
@@ -542,6 +554,8 @@ def _worker_featurizer() -> dict:
             "model": model_name, "wall_s": dt,
             "compute_dtype": os.environ.get("BENCH_FEAT_DTYPE", "bfloat16"),
             "native_packer": native_mod.available(),
+            "decode_workers": decode_workers_default(),
+            "stage_seconds": stage_seconds,
             "breakdown": {k: round(v, 3) if isinstance(v, float) else v
                           for k, v in breakdown.items()}}
 
@@ -1359,6 +1373,12 @@ def main():
             k: feat[k] for k in ("rows", "batch_size", "compute_dtype",
                                  "native_packer")}
         extra["featurizer_breakdown"] = feat.get("breakdown", {})
+        # The inference-throughput record, next to the training one: the
+        # streaming engine's rate + per-stage span breakdown (ISSUE 3).
+        extra["inference"] = {
+            "rows_per_sec": round(feat["rows_per_sec"], 2),
+            "decode_workers": feat.get("decode_workers"),
+            "stage_seconds": feat.get("stage_seconds", {})}
     elif feat_err:
         extra["featurizer_error"] = feat_err
     if bert:

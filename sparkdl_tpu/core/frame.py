@@ -18,6 +18,7 @@ without intermediate materialization), which is what feeds the HBM pipeline in
 
 from __future__ import annotations
 
+import collections
 from typing import Any, Callable, Iterator, Sequence
 
 import numpy as np
@@ -120,6 +121,21 @@ class DataFrame:
         """The mapPartitions analogue — everything lowers to this."""
         return DataFrame(self._partitions, self._ops + (fn,))
 
+    def mapStream(self, fn: Callable[[Iterator[pa.RecordBatch]],
+                                     Iterator[pa.RecordBatch]]) -> "DataFrame":
+        """Stream-level mapBatches: ``fn`` sees the iterator of ALL
+        partition batches at materialization time and yields exactly one
+        same-length output batch per input batch, in order.
+
+        This is the primitive behind the streaming inference engine: a
+        per-batch op (``mapBatches``) is re-invoked per partition, so any
+        device pipeline inside it drains its in-flight window at every
+        partition boundary; a stream op is invoked ONCE per materialization
+        and can keep one continuous batch stream flowing through the
+        device across partitions. Still lazy — the op chain composes and
+        runs single-pass like every other narrow op."""
+        return DataFrame(self._partitions, self._ops + (_StreamOp(fn),))
+
     def select(self, *cols: str) -> "DataFrame":
         names = list(cols)
         return self.mapBatches(_row_wise_op(lambda b: b.select(names)))
@@ -176,14 +192,29 @@ class DataFrame:
         return self.mapBatches(op)
 
     # -- materialization ---------------------------------------------------
-    def _apply_ops(self, batch: pa.RecordBatch) -> pa.RecordBatch:
+    def _apply_ops_stream(self, stream: Iterator[pa.RecordBatch]
+                          ) -> Iterator[pa.RecordBatch]:
+        """Compose the op chain over a batch stream: per-batch ops map
+        batch-wise, stream ops wrap the whole iterator (each output batch
+        still corresponds 1:1, in order, to an input batch). Lazy —
+        nothing runs until the returned iterator is pulled."""
         for op in self._ops:
-            batch = op(batch)
-        return batch
+            if isinstance(op, _StreamOp):
+                stream = op.fn(stream)
+            else:
+                stream = map(op, stream)
+        return stream
+
+    def _apply_ops(self, batch: pa.RecordBatch) -> pa.RecordBatch:
+        out = None
+        for out in self._apply_ops_stream(iter([batch])):
+            pass
+        if out is None:
+            raise ValueError("stream op yielded no batch for its input")
+        return out
 
     def iterPartitions(self) -> Iterator[pa.RecordBatch]:
-        for p in self._partitions:
-            yield self._apply_ops(p)
+        yield from self._apply_ops_stream(iter(self._partitions))
 
     def _streamable(self) -> bool:
         """True when every pending op is tagged ROW-WISE (each output row
@@ -216,20 +247,43 @@ class DataFrame:
 
         Partition boundaries are erased: output batches are exactly
         ``batchSize`` rows except possibly the last, which is what a static-
-        shape XLA program wants (pad-and-mask handled downstream)."""
-        carry: pa.Table | None = None
+        shape XLA program wants (pad-and-mask handled downstream).
+
+        The carry is a deque of zero-copy batch slices, drained head-first
+        per emitted batch — each row is concatenated exactly once, so the
+        re-chunking cost stays linear in rows however many tiny partitions
+        feed it (the old table-carry re-concatenated the whole remainder
+        per partition: quadratic on many-small-partition datasets).
+        """
+        buf: collections.deque[pa.RecordBatch] = collections.deque()
+        buffered = 0
+
+        def emit(n: int) -> pa.RecordBatch:
+            nonlocal buffered
+            take, taken = [], 0
+            while taken < n:
+                b = buf.popleft()
+                need = n - taken
+                if b.num_rows > need:
+                    buf.appendleft(b.slice(need))  # zero-copy remainder
+                    b = b.slice(0, need)
+                take.append(b)
+                taken += b.num_rows
+            buffered -= n
+            if len(take) == 1 and take[0].num_rows == n:
+                return take[0]
+            t = pa.Table.from_batches(take).combine_chunks()
+            return t.to_batches(max_chunksize=n)[0]
+
         for part in self._iter_materialized(batchSize):
-            t = pa.Table.from_batches([part]) if part.num_rows else None
-            if t is None:
+            if not part.num_rows:
                 continue
-            carry = t if carry is None else pa.concat_tables([carry, t])
-            while carry.num_rows >= batchSize:
-                head = carry.slice(0, batchSize).combine_chunks()
-                yield head.to_batches(max_chunksize=batchSize)[0]
-                carry = carry.slice(batchSize)
-        if carry is not None and carry.num_rows:
-            rest = carry.combine_chunks()
-            yield rest.to_batches(max_chunksize=rest.num_rows)[0]
+            buf.append(part)
+            buffered += part.num_rows
+            while buffered >= batchSize:
+                yield emit(batchSize)
+        if buffered:
+            yield emit(buffered)
 
     def cache(self) -> "DataFrame":
         """Materialize the op chain now (eager) — analogous to df.cache()."""
@@ -414,6 +468,20 @@ class DataFrame:
             cols = "?"
         return (f"DataFrame[{cols}] "
                 f"({self.numPartitions} partition(s), {len(self._ops)} pending op(s))")
+
+
+class _StreamOp:
+    """A stream-level op (see :meth:`DataFrame.mapStream`): ``fn`` maps the
+    whole partition-batch iterator, one same-length output batch per input
+    batch. Length-preserving by contract (so ``limit``/``count`` keep
+    their lazy fast paths) but NOT row-wise: it must see partition-sized
+    batches, never sub-partition slices."""
+
+    __slots__ = ("fn",)
+    _changes_length = False
+
+    def __init__(self, fn):
+        self.fn = fn
 
 
 def _op_changes_length(op) -> bool:

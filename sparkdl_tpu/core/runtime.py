@@ -22,7 +22,9 @@ from __future__ import annotations
 
 import collections
 import itertools
+import logging
 import math
+import os
 import queue as queue_mod
 import threading
 from typing import Any, Callable, Iterable, Iterator, Sequence
@@ -30,6 +32,15 @@ from typing import Any, Callable, Iterable, Iterator, Sequence
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+log = logging.getLogger("sparkdl_tpu.runtime")
+
+
+def _events():
+    """Flight recorder, lazily (the runner package imports heavyweight
+    siblings; resolving it per call is a sys.modules hit after the first)."""
+    from sparkdl_tpu.runner import events
+    return events
 
 
 def devices() -> list:
@@ -163,6 +174,67 @@ def transfer_workers_default() -> int:
     return int(os.environ.get("SPARKDL_TRANSFER_WORKERS", "0"))
 
 
+def _windowed_apply(fn: Callable, items: Iterable, depth: int, workers: int,
+                    thread_prefix: str) -> Iterator:
+    """THE submit-ahead window (one copy: the HBM put feed, the decode
+    pool, and run_stream's put stage all ride it): apply ``fn`` to each
+    item keeping up to ``depth`` results in flight ahead of the consumer,
+    yielding strictly in input order.
+
+    ``workers <= 0`` applies inline — with ``depth > 0`` results are still
+    produced ahead into the window (right for async-returning fns like
+    ``device_put``: the transfer proceeds while earlier results are
+    consumed), with ``depth <= 0`` it is a plain lazy map. ``workers > 0``
+    submits to a thread pool with in-flight depth ``max(depth, workers)``
+    (idle threads would defeat the knob); exceptions re-raise at the
+    consumption point, and closing the generator cancels un-started work.
+    """
+    it = iter(items)
+    window: collections.deque = collections.deque()
+    sentinel = object()
+    if workers <= 0:
+        if depth <= 0:
+            for item in it:
+                yield fn(item)
+            return
+        for item in itertools.islice(it, depth):
+            window.append(fn(item))
+        while window:
+            out = window.popleft()
+            nxt = next(it, sentinel)
+            if nxt is not sentinel:
+                window.append(fn(nxt))
+            yield out
+        return
+    from concurrent.futures import ThreadPoolExecutor
+    depth = max(depth, workers, 1)
+    pool = ThreadPoolExecutor(max_workers=workers,
+                              thread_name_prefix=thread_prefix)
+    try:
+        for item in itertools.islice(it, depth):
+            window.append(pool.submit(fn, item))
+        while window:
+            fut = window.popleft()
+            nxt = next(it, sentinel)
+            if nxt is not sentinel:
+                window.append(pool.submit(fn, nxt))
+            yield fut.result()
+    finally:
+        for f in window:
+            f.cancel()
+        pool.shutdown(wait=False, cancel_futures=True)
+
+
+def _put_fn(sharding: NamedSharding | None) -> Callable:
+    """The one device_put closure shared by the feed paths."""
+    def put(batch):
+        if sharding is not None:
+            return jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, sharding), batch)
+        return jax.tree_util.tree_map(jax.device_put, batch)
+    return put
+
+
 def prefetch_to_device(iterator: Iterable, size: int = 2,
                        sharding: NamedSharding | None = None,
                        transfer_workers: int | None = None) -> Iterator:
@@ -183,42 +255,8 @@ def prefetch_to_device(iterator: Iterable, size: int = 2,
     """
     workers = (transfer_workers_default() if transfer_workers is None
                else transfer_workers)
-
-    def put(batch):
-        if sharding is not None:
-            return jax.tree_util.tree_map(
-                lambda x: jax.device_put(x, sharding), batch)
-        return jax.tree_util.tree_map(jax.device_put, batch)
-
-    it = iter(iterator)
-    queue: collections.deque = collections.deque()
-    if workers <= 0:
-        if size <= 0:  # no lookahead: plain put-and-yield, never drop rows
-            for batch in it:
-                yield put(batch)
-            return
-        for batch in itertools.islice(it, size):
-            queue.append(put(batch))
-        while queue:
-            out = queue.popleft()
-            nxt = next(it, None)
-            if nxt is not None:
-                queue.append(put(nxt))
-            yield out
-        return
-
-    from concurrent.futures import ThreadPoolExecutor
-    depth = max(size, workers)
-    with ThreadPoolExecutor(max_workers=workers,
-                            thread_name_prefix="sparkdl-put") as pool:
-        for batch in itertools.islice(it, depth):
-            queue.append(pool.submit(put, batch))
-        while queue:
-            fut = queue.popleft()
-            nxt = next(it, None)
-            if nxt is not None:
-                queue.append(pool.submit(put, nxt))
-            yield fut.result()
+    yield from _windowed_apply(_put_fn(sharding), iterator, size, workers,
+                               "sparkdl-put")
 
 
 def background_iter(iterator: Iterable, maxsize: int = 2) -> Iterator:
@@ -276,6 +314,47 @@ def background_iter(iterator: Iterable, maxsize: int = 2) -> Iterator:
         cancelled.set()
 
 
+def decode_workers_default() -> int:
+    """Host decode parallelism for the inference feed
+    (``SPARKDL_DECODE_WORKERS``; default 2). The Arrow→NHWC pack and PIL
+    resize release the GIL, so N workers keep N cores decoding — one
+    background thread (the pre-streaming design) caps the feed at a single
+    core's decode rate however fast the device drains it. 0 = decode
+    inline on the consumer thread (no overlap; debugging)."""
+    try:
+        return int(os.environ.get("SPARKDL_DECODE_WORKERS", "2"))
+    except ValueError:
+        return 2
+
+
+def parallel_map_iter(fn: Callable, items: Iterable, workers: int | None = None,
+                      maxsize: int | None = None) -> Iterator:
+    """Order-preserving parallel map over an iterator — the host decode pool.
+
+    Up to ``max(workers, maxsize)`` applications of ``fn`` stay in flight on
+    a thread pool; results yield strictly in submission order, so a
+    slow-to-decode chunk never reorders the stream. Like
+    :func:`prefetch_to_device`, submission is pull-driven: each yield tops
+    the window back up, so the pool runs ahead of the consumer by the
+    window depth and no producer thread needs cancelling. Exceptions from
+    ``fn`` re-raise at the consumption point; closing the generator cancels
+    whatever has not started.
+
+    ``workers=None`` → :func:`decode_workers_default`; ``workers<=0`` maps
+    inline (serial).
+    """
+    workers = decode_workers_default() if workers is None else int(workers)
+    # depth 0 when inline: decode is synchronous CPU work — running it
+    # ahead on the consumer thread would serialize identically, unlike
+    # the async device_put feed.
+    yield from _windowed_apply(
+        fn, items, 0 if workers <= 0 else max(workers, maxsize or 0),
+        workers, "sparkdl-decode")
+
+
+_runner_ids = itertools.count()
+
+
 class BatchRunner:
     """Drives one jitted function over a stream of host batches.
 
@@ -290,9 +369,18 @@ class BatchRunner:
     tunnel: ~65ms per blocking round-trip, measured round 3) serializing
     put→run→fetch per batch costs 2-3 round-trips per batch; the in-flight
     window hides all but the last.
+
+    :meth:`run_stream` is the streaming-engine entry point: it drives the
+    SAME window over one continuous batch stream with arbitrary host-side
+    metadata riding alongside each batch — callers feed the whole dataset
+    (all partitions) through one call, so the in-flight window never
+    drains at a partition boundary. :meth:`run` is the meta-less wrapper.
+    Every stage emits flight-recorder spans (``pad``/``put``/``dispatch``/
+    ``fetch``) so postmortems and bench can see where scoring time goes.
     """
 
-    def __init__(self, fn: Callable, batch_size: int, donate: bool = False,
+    def __init__(self, fn: Callable, batch_size: int,
+                 donate: bool | None = None,
                  prefetch: int = 2, mesh: Mesh | None = None,
                  data_axis: str = "data", input_cast=None):
         """``mesh``: when given, input batches are device_put *sharded* over
@@ -304,7 +392,21 @@ class BatchRunner:
         ``input_cast``: a dtype (e.g. ``jnp.float32``): every input leaf is
         cast to it *inside* the jitted program. Feed uint8 host batches and
         the cast fuses into the first consumer op — 4x fewer bytes over the
-        host→HBM link than pre-cast float32 feeds."""
+        host→HBM link than pre-cast float32 feeds.
+
+        ``donate``: donate the input buffer to the program — XLA may alias
+        it for outputs/scratch, shaving one HBM buffer per in-flight batch.
+        Default from ``SPARKDL_INFER_DONATE`` (off: on backends that cannot
+        alias a given shape jax warns per dispatch, and inference inputs
+        rarely match output shapes)."""
+        if donate is None:
+            donate = os.environ.get("SPARKDL_INFER_DONATE", "") \
+                in ("1", "true", "yes")
+        # Per-runner identity for recompile accounting: each runner owns
+        # its own jit cache, so the same shapes through a NEW runner are a
+        # real recompile, not a hit.
+        self._sig_name = (f"BatchRunner:{getattr(fn, '__name__', 'fn')}"
+                          f":{next(_runner_ids)}")
         self.batch_size = int(batch_size)
         if mesh is not None:
             n_shard = int(mesh.shape[data_axis])
@@ -324,28 +426,72 @@ class BatchRunner:
     def run(self, batches: Iterable[np.ndarray | dict]) -> Iterator[np.ndarray]:
         """batches: iterator of host arrays/dicts with leading batch dim ≤
         batch_size. Yields numpy outputs with pad rows removed."""
+        for out, _ in self.run_stream((b, None) for b in batches):
+            yield out
+
+    def run_stream(self, batches: Iterable[tuple]) -> Iterator[tuple]:
+        """Persistent pipeline over one continuous batch stream.
+
+        ``batches``: iterator of ``(host_batch, meta)`` — ``meta`` is any
+        host-side value (the streaming transformers carry partition
+        identity/row counts here) and rides the pipeline untouched. Yields
+        ``(numpy_output_with_pad_rows_removed, meta)`` in input order.
+
+        The in-flight window (``prefetch`` dispatched executions with
+        async device→host copies, plus the same depth of pending
+        ``device_put``) spans the WHOLE stream: feeding every partition of
+        a dataset through one call keeps the device busy across partition
+        boundaries instead of draining per partition. ``n_valid`` threads
+        through the window next to each batch — no ``itertools.tee``, so
+        no padded host copies stay pinned alongside their device copies.
+        """
+        ev = _events()
 
         def staged():
-            for b in batches:
-                yield pad_batch(b, self.batch_size)
-        # Prefetch only the device-bound leaves; n_valid stays host-side.
-        arr_it, n_it = itertools.tee(staged())
-        dev_stream = prefetch_to_device((a for a, _ in arr_it), self.prefetch,
-                                        sharding=self._sharding)
+            for b, meta in batches:
+                with ev.span("pad"):
+                    padded, n = pad_batch(b, self.batch_size)
+                yield padded, n, meta
+
+        put = _put_fn(self._sharding)
+
+        def put_slot(slot):
+            # n/meta ride each window slot (never tee'd) through the
+            # shared submit-ahead window — same contract as
+            # prefetch_to_device, with SPARKDL_TRANSFER_WORKERS pooling.
+            padded, n, meta = slot
+            with ev.span("put"):
+                return put(padded), n, meta
+
+        def put_stream():
+            return _windowed_apply(put_slot, staged(), self.prefetch,
+                                   transfer_workers_default(),
+                                   "sparkdl-put")
 
         def fetch(item):
-            out, n = item
-            out_np = jax.tree_util.tree_map(np.asarray, out)
-            return jax.tree_util.tree_map(lambda x: x[:n], out_np)
+            out, n, meta = item
+            with ev.span("fetch", rows=n):
+                out_np = jax.tree_util.tree_map(np.asarray, out)
+                return (jax.tree_util.tree_map(lambda x: x[:n], out_np),
+                        meta)
 
         window: collections.deque = collections.deque()
-        for dev_batch, (_, n) in zip(dev_stream, n_it):
-            out = self._jitted(dev_batch)
-            # Start the device→host copy now; block only when popped.
-            for leaf in jax.tree_util.tree_leaves(out):
-                if hasattr(leaf, "copy_to_host_async"):
-                    leaf.copy_to_host_async()
-            window.append((out, n))
+        for dev_batch, n, meta in put_stream():
+            # Signature accounting BEFORE the dispatch: a pad bug or
+            # mixed-shape stream shows up as `recompile` events (and in
+            # meter.summary()["compile_cache"]) instead of a silent
+            # 20-40s stall per odd-shaped chunk.
+            GLOBAL_COMPILE_CACHE.note(self._sig_name, (
+                jax.tree_util.tree_structure(dev_batch),
+                tuple((leaf.shape, str(leaf.dtype))
+                      for leaf in jax.tree_util.tree_leaves(dev_batch))))
+            with ev.span("dispatch", rows=n):
+                out = self._jitted(dev_batch)
+                # Start the device→host copy now; block only when popped.
+                for leaf in jax.tree_util.tree_leaves(out):
+                    if hasattr(leaf, "copy_to_host_async"):
+                        leaf.copy_to_host_async()
+            window.append((out, n, meta))
             if len(window) > self.prefetch:
                 yield fetch(window.popleft())
         while window:
@@ -375,26 +521,157 @@ class CompileCache:
         self.misses = 0
         self.hits = 0
 
+    def note(self, name: str, key) -> bool:
+        """Record one call signature; True when it is NEW for ``name``.
+
+        Silent recompilation is the primary TPU perf failure mode — every
+        new (fn, signature) pair becomes a visible flight-recorder
+        ``recompile`` event, so traces/postmortems show a recompile storm
+        instead of mysterious step-time spikes. Shared by the jit wrapper
+        below and ``BatchRunner``'s dispatch loop."""
+        with self._lock:
+            seen = self._keys.setdefault(name, set())
+            if key in seen:
+                self.hits += 1
+                return False
+            seen.add(key)
+            self.misses += 1
+            misses = self.misses
+        _events().event("recompile", fn=name, misses=misses,
+                        shapes=str(key)[:200])
+        return True
+
     def get(self, name: str, fn: Callable, static_argnums=()) -> Callable:
         with self._lock:
             if name not in self._fns:
                 self._fns[name] = jax.jit(fn, static_argnums=static_argnums)
-                self._keys[name] = set()
         jitted = self._fns[name]
 
         def wrapped(*args, **kwargs):
             key = jax.tree_util.tree_structure((args, kwargs)), tuple(
                 (getattr(x, "shape", None), str(getattr(x, "dtype", "")))
                 for x in jax.tree_util.tree_leaves((args, kwargs)))
-            with self._lock:
-                if key in self._keys[name]:
-                    self.hits += 1
-                else:
-                    self._keys[name].add(key)
-                    self.misses += 1
+            self.note(name, key)
             return jitted(*args, **kwargs)
 
         return wrapped
 
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses}
+
 
 GLOBAL_COMPILE_CACHE = CompileCache()
+
+
+# ---------------------------------------------------------------------------
+# Persistent (on-disk) XLA compilation cache
+# ---------------------------------------------------------------------------
+
+COMPILE_CACHE_ENV = "SPARKDL_COMPILE_CACHE"
+_PERSISTENT_CACHE_STATS = {"hits": 0, "misses": 0, "dir": None}
+_persistent_cache_lock = threading.Lock()
+_persistent_listener_registered = False
+
+
+def enable_persistent_compile_cache(path: str | None = None) -> str | None:
+    """Point JAX's persistent compilation cache at ``path`` (default:
+    ``$SPARKDL_COMPILE_CACHE``) and arm hit/miss telemetry.
+
+    With the cache on, a *second* process compiling the same program —
+    a supervised gang restart, a repeat scoring job — loads the compiled
+    executable from disk instead of recompiling (20-40s per program on
+    the axon TPU). ``jax_persistent_cache_min_compile_time_secs`` is
+    dropped to 0 so every program is cached, not only slow ones
+    (override: ``SPARKDL_COMPILE_CACHE_MIN_S``). Idempotent; returns the
+    cache dir, or None when no path is configured.
+
+    Every persistent-cache hit/miss emits a ``compile_cache`` flight-
+    recorder event and increments :func:`persistent_cache_stats`.
+    """
+    global _persistent_listener_registered
+    path = path or os.environ.get(COMPILE_CACHE_ENV)
+    if not path:
+        return None
+    try:
+        os.makedirs(path, exist_ok=True)
+    except OSError as e:
+        # A bad cache path must degrade to no-cache, never kill the job:
+        # this runs at import time in every process inheriting the env
+        # var (gang workers included) — raising here would turn a config
+        # typo into a hard full-gang failure.
+        log.warning("persistent compile cache disabled: cannot create "
+                    "%s (%s)", path, e)
+        return None
+    jax.config.update("jax_compilation_cache_dir", path)
+    try:
+        min_s = float(os.environ.get("SPARKDL_COMPILE_CACHE_MIN_S", "0"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          min_s)
+    except (ValueError, AttributeError):
+        pass
+    try:
+        # jax latches "cache unused" at the FIRST compile of the process;
+        # enabling after any jit call would otherwise be a silent no-op.
+        from jax._src.compilation_cache import reset_cache
+        reset_cache()
+    except Exception:
+        pass
+    with _persistent_cache_lock:
+        _PERSISTENT_CACHE_STATS["dir"] = path
+        if not _persistent_listener_registered:
+            try:
+                from jax._src import monitoring as _mon
+
+                def _listener(event: str, **attrs):
+                    # A persistent-cache hit = this process skipped the
+                    # 20-40s XLA recompile; a miss = it paid it. Exactly
+                    # the signals supervise() postmortems and the score
+                    # smoke need, so both land in the event stream.
+                    if event == "/jax/compilation_cache/cache_hits":
+                        key, outcome = "hits", "hit"
+                    elif event == "/jax/compilation_cache/cache_misses":
+                        key, outcome = "misses", "miss"
+                    else:
+                        return
+                    with _persistent_cache_lock:
+                        if _PERSISTENT_CACHE_STATS["dir"] is None:
+                            return  # disabled since registration
+                        _PERSISTENT_CACHE_STATS[key] += 1
+                        n = _PERSISTENT_CACHE_STATS[key]
+                    _events().event("compile_cache", outcome=outcome,
+                                    count=n)
+
+                _mon.register_event_listener(_listener)
+                _persistent_listener_registered = True
+            except Exception:  # private API — degrade to dir-only wiring
+                log.warning("jax monitoring unavailable; persistent "
+                            "compile-cache hit/miss telemetry disabled")
+    _events().event("compile_cache", outcome="enabled", dir=path)
+    return path
+
+
+def disable_persistent_compile_cache() -> None:
+    """Turn the persistent cache off and clear its telemetry — the
+    registered listener goes quiet (it gates on ``dir``), so a process
+    that reconfigures or drops the cache stops reporting stale
+    counters in ``meter.summary()``."""
+    jax.config.update("jax_compilation_cache_dir", None)
+    with _persistent_cache_lock:
+        _PERSISTENT_CACHE_STATS.update(hits=0, misses=0, dir=None)
+
+
+def persistent_cache_stats() -> dict:
+    """``{"hits": N, "misses": N, "dir": path|None}`` for the persistent
+    compilation cache (zeros until :func:`enable_persistent_compile_cache`
+    armed the listener and a compile went through it)."""
+    with _persistent_cache_lock:
+        return dict(_PERSISTENT_CACHE_STATS)
+
+
+if os.environ.get(COMPILE_CACHE_ENV):
+    # Env-driven: any process importing the runtime (scoring jobs, gang
+    # workers spawned by launcher.supervise) gets the persistent cache
+    # without code changes — the restart path that motivates it cannot
+    # rely on user code calling an API first.
+    enable_persistent_compile_cache()

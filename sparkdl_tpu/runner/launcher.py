@@ -194,6 +194,16 @@ def _spawn_gang(script: str, np: int, args, env, coordinator: str | None,
             penv["SPARKDL_HEARTBEAT_DIR"] = heartbeat_dir
         if event_dir:
             penv["SPARKDL_EVENT_DIR"] = event_dir
+        # Persistent XLA compilation cache: a supervised gang restart pays
+        # the 20-40s compile once, ever — relaunched workers load the
+        # executable from disk. SPARKDL_COMPILE_CACHE flows to workers
+        # that import sparkdl_tpu (core.runtime arms it + hit/miss
+        # telemetry); the raw JAX var is ALSO set so jax-only worker
+        # scripts get the cache without the framework import. Never
+        # overrides a caller's explicit JAX_COMPILATION_CACHE_DIR.
+        cache_dir = penv.get("SPARKDL_COMPILE_CACHE")
+        if cache_dir and not penv.get("JAX_COMPILATION_CACHE_DIR"):
+            penv["JAX_COMPILATION_CACHE_DIR"] = cache_dir
         p = subprocess.Popen(
             [sys.executable, script] + list(args or []),
             env=penv,
@@ -534,6 +544,12 @@ def supervise(script: str, np: int = 2, args: list[str] | None = None,
     :class:`~sparkdl_tpu.runner.chaos.FaultPlan` into the workers' env; a
     plan without a ``state_dir`` gets a temp one so ``once`` faults stay
     once across relaunches.
+
+    With ``SPARKDL_COMPILE_CACHE`` set (supervisor env or ``env=``), every
+    rank gets JAX's persistent compilation cache pointed at it
+    (``JAX_COMPILATION_CACHE_DIR``), so restart N+1 loads its compiled
+    programs from disk instead of re-paying the 20-40s XLA compile that
+    would otherwise dominate each recovery.
 
     The flight recorder is armed in every supervised rank: ``event_dir``
     (or ``SPARKDL_EVENT_DIR`` in ``env``/the supervisor's environment, or

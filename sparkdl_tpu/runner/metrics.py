@@ -290,7 +290,30 @@ class ThroughputMeter:
             "n_chips": self.n_chips,
             "step_time": st or None,
             "mfu": round(mfu, 4) if mfu is not None else None,
+            "compile_cache": compile_cache_summary(),
         }
+
+
+def compile_cache_summary() -> dict | None:
+    """Process-wide compilation visibility for ``meter.summary()``:
+    jit-signature hits/misses from ``runtime.GLOBAL_COMPILE_CACHE``
+    (every miss is a recompile — the stated primary TPU perf failure
+    mode, previously invisible outside its own counters) plus the
+    persistent on-disk cache's hit/miss tally when armed. None when
+    nothing has been recorded, so quiet runs stay quiet."""
+    try:
+        from sparkdl_tpu.core.runtime import (GLOBAL_COMPILE_CACHE,
+                                              persistent_cache_stats)
+    except Exception:
+        return None
+    out: dict = {}
+    snap = GLOBAL_COMPILE_CACHE.snapshot()
+    if snap["hits"] or snap["misses"]:
+        out.update(snap)
+    pstats = persistent_cache_stats()
+    if pstats.get("dir"):
+        out["persistent"] = pstats
+    return out or None
 
 
 class MetricsLogger:

@@ -91,9 +91,12 @@ class VectorAssembler(Transformer, HasOutputCol):
                 pieces.append(columnToNdarray(arr, None, dtype=np.float64,
                                               atleast_2d=True))
             flat = np.concatenate(pieces, axis=1)
-            return _set_column(batch, out_col,
-                               pa.array(list(flat), type=pa.list_(
-                                   pa.float64())))
+            # packed list<double> straight from the flat buffer (shared
+            # with the scoring engine's output encode) — no per-row Python
+            # list materialization on a column that may be the widest in
+            # the pipeline
+            from .xla_image import arrayColumnToArrow
+            return _set_column(batch, out_col, arrayColumnToArrow(flat))
 
         # row-wise: each output row depends only on its own input row, so
         # the chain stays streamable (O(batchSize) host memory upstream)
@@ -294,9 +297,9 @@ class StandardScalerModel(Model, HasInputCol, HasOutputCol):
                 x = x - mean
             if div_std:
                 x = x * factor
-            return _set_column(batch, out_col,
-                               pa.array(list(x), type=pa.list_(
-                                   pa.float64())))
+            # packed list<double> from the flat buffer (see VectorAssembler)
+            from .xla_image import arrayColumnToArrow
+            return _set_column(batch, out_col, arrayColumnToArrow(x))
 
         return dataset.mapBatches(_row_wise_op(op))
 

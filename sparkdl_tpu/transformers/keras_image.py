@@ -13,11 +13,11 @@ from __future__ import annotations
 import numpy as np
 import pyarrow as pa
 
-from ..core.frame import DataFrame, _length_preserving, _set_column
+from ..core.frame import DataFrame
 from ..core.params import (HasBatchSize, HasInputCol, HasOutputCol, Param,
                            Params, TypeConverters, keyword_only)
 from ..core.pipeline import Transformer
-from ..core.runtime import BatchRunner, background_iter
+from ..core.runtime import BatchRunner
 from .keras_utils import keras_file_to_fn
 from .payloads import BundlesModelFile, PicklesCallableParams
 from .xla_image import arrayColumnToArrow
@@ -100,24 +100,22 @@ class KerasImageFileTransformer(BundlesModelFile, PicklesCallableParams,
         loader = self.getOrDefault(self.imageLoader)
         runner = self._get_runner()
 
-        def op(batch: pa.RecordBatch) -> pa.RecordBatch:
-            from .xla_image import emptyVectorColumn
-            if batch.num_rows == 0:
-                return _set_column(batch, out_col, emptyVectorColumn())
+        def chunk_thunks(batch: pa.RecordBatch) -> list:
             uris = batch.column(in_col).to_pylist()
-            # Load lazily per device chunk, with the decode itself fanned
-            # over a thread pool AND running one chunk ahead on a feeder
-            # thread (background_iter) — chunk k+1 decodes in parallel
-            # while the TPU computes chunk k; peak host memory is one
-            # chunk + the queue, not the whole partition.
-            chunks = background_iter(
-                (loadImageBatch(loader, uris[i:i + batch_size])
-                 for i in range(0, len(uris), batch_size)),
-                maxsize=runner.prefetch)
-            outs = list(runner.run(chunks))
-            result = np.concatenate([np.asarray(o) for o in outs], axis=0)
-            return _set_column(batch, out_col, arrayColumnToArrow(result))
+            # Load lazily per device chunk: each thunk fans its URI batch
+            # over the shared decode executor (loadImageBatch) AND the
+            # thunks themselves pipeline on the scorer's decode pool —
+            # chunk k+1 loads while the TPU computes chunk k, across
+            # partition boundaries. Peak host memory is one chunk x the
+            # in-flight window, not the whole partition.
+            return [
+                lambda i=i: loadImageBatch(loader, uris[i:i + batch_size])
+                for i in range(0, len(uris), batch_size)]
 
-        return dataset.mapBatches(_length_preserving(op))
+        from .streaming import StreamScorer
+        from .xla_image import emptyVectorColumn
+        return dataset.mapStream(StreamScorer(
+            runner, out_col, chunk_thunks, arrayColumnToArrow,
+            emptyVectorColumn))
 
     _pickled_params = ("imageLoader",)

@@ -217,8 +217,17 @@ class DeepImagePredictor(_NamedImageTransformer):
         top = self.getOrDefault(self.topK)
 
         def decode_op(batch: pa.RecordBatch) -> pa.RecordBatch:
-            logits = np.asarray(batch.column(out_col).to_pylist(),
-                                dtype=np.float32)
+            if batch.num_rows == 0:
+                typ = pa.list_(pa.struct([("class", pa.int32()),
+                                          ("label", pa.string()),
+                                          ("score", pa.float32())]))
+                return _set_column(batch, out_col, pa.array([], type=typ))
+            # zero-copy Arrow→ndarray off the packed logits column — the
+            # to_pylist round-trip built 1000 Python floats per row on the
+            # scoring hot path.
+            from .tensor import columnToNdarray
+            logits = columnToNdarray(batch.column(out_col), None,
+                                     dtype=np.float32)
             decoded = model_registry.decodePredictions(logits, top=top)
             typ = pa.list_(pa.struct([("class", pa.int32()),
                                       ("label", pa.string()),

@@ -1,0 +1,156 @@
+"""StreamScorer — the cross-partition streaming inference engine.
+
+Every inference transformer used to lower to a per-partition ``mapBatches``
+op that built its own decode thread + ``BatchRunner.run`` generator per
+partition — so the device's in-flight window drained at EVERY partition
+boundary, decode ran on ONE background thread, and Arrow output encoding
+serialized between device fetches. On many-small-partition datasets the TPU
+idled for most of the wall clock.
+
+This module is the shared replacement (ISSUE 3 tentpole): one
+:class:`StreamScorer` instance becomes a ``DataFrame.mapStream`` op that
+
+- chunks every partition into device batches and decodes them on the
+  parallel, order-preserving host pool (``runtime.parallel_map_iter``,
+  ``SPARKDL_DECODE_WORKERS`` workers) — each decode wrapped in a ``decode``
+  flight-recorder span;
+- feeds the WHOLE dataset's chunk stream through one
+  ``BatchRunner.run_stream`` call, partition identity and row counts riding
+  host-side as the stream metadata — the pad/put/dispatch/fetch window
+  never drains between partitions;
+- encodes device outputs to their final Arrow form on an overlap worker
+  (``encode`` spans), so the consumer loop goes straight back to fetching
+  the next device result instead of blocking on ``nhwcToStructs`` /
+  ``arrayColumnToArrow``;
+- reassembles one output RecordBatch per input partition, in order, with
+  the int32→large_list offset promotion handled once in
+  :func:`concatChunkArrays`.
+
+Peak host memory stays O(window · batchSize) decoded rows + the pending
+partitions whose chunks are in flight — the same O(batchSize) contract the
+per-partition design had, now without the per-boundary stalls.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Callable, Iterator
+
+import numpy as np
+import pyarrow as pa
+
+from ..core.frame import _set_column
+from ..core.runtime import BatchRunner, parallel_map_iter
+
+
+def concatChunkArrays(pieces: list[pa.Array]) -> pa.Array:
+    """Concatenate per-chunk output arrays into one partition column.
+
+    int32 list offsets overflow past 2**31 total values — every piece is
+    promoted to large_list before concat when the total crosses that line
+    (the single-piece path gets this inside ``arrayColumnToArrow``)."""
+    if len(pieces) == 1:
+        return pieces[0]
+    total = sum(len(p.values) if isinstance(
+        p, (pa.ListArray, pa.LargeListArray)) else 0 for p in pieces)
+    if total > np.iinfo(np.int32).max:
+        pieces = [p.cast(pa.large_list(p.type.value_type))
+                  if isinstance(p, pa.ListArray) else p for p in pieces]
+    return pa.concat_arrays(pieces)
+
+
+class StreamScorer:
+    """``DataFrame.mapStream`` op scoring a column through a BatchRunner.
+
+    Per-transformer behavior plugs in via three callables:
+
+    - ``chunk_thunks(batch) -> list[() -> host_array]``: split one
+      partition into device-batch decode thunks (each runs on the decode
+      pool and returns the host array for one ``BatchRunner`` batch);
+    - ``encode(np.ndarray) -> pa.Array``: device output chunk → its final
+      Arrow representation (runs on the overlap worker);
+    - ``empty_array() -> pa.Array``: output column for a zero-row
+      partition.
+    """
+
+    def __init__(self, runner: BatchRunner, out_col: str,
+                 chunk_thunks: Callable, encode: Callable,
+                 empty_array: Callable, decode_workers: int | None = None):
+        self.runner = runner
+        self.out_col = out_col
+        self.chunk_thunks = chunk_thunks
+        self.encode = encode
+        self.empty_array = empty_array
+        self.decode_workers = decode_workers
+
+    # -- stages ------------------------------------------------------------
+    def _decode(self, item):
+        thunk, entry = item
+        from ..core.runtime import _events
+        with _events().span("decode"):
+            return thunk(), entry
+
+    def _encode(self, result: np.ndarray) -> pa.Array:
+        from ..core.runtime import _events
+        with _events().span("encode", rows=len(result)):
+            return self.encode(result)
+
+    def _finish(self, entry: dict) -> pa.RecordBatch:
+        batch = entry["batch"]
+        if not entry["n_chunks"]:
+            return _set_column(batch, self.out_col, self.empty_array())
+        pieces = [f.result() for f in entry["futs"]]
+        return _set_column(batch, self.out_col, concatChunkArrays(pieces))
+
+    # -- the stream op -----------------------------------------------------
+    def __call__(self, parts: Iterator[pa.RecordBatch]
+                 ) -> Iterator[pa.RecordBatch]:
+        from concurrent.futures import ThreadPoolExecutor
+        # Entries appear here in partition order as the chunk producer
+        # (pulled on this thread through the decode pool / put window)
+        # walks the input; each holds its RecordBatch and expected chunk
+        # count host-side — the row-count bookkeeping the continuous
+        # device stream does not carry.
+        pending: collections.deque[dict] = collections.deque()
+
+        def chunk_stream():
+            for rb in parts:
+                thunks = self.chunk_thunks(rb) if rb.num_rows else []
+                entry = {"batch": rb, "n_chunks": len(thunks), "futs": []}
+                pending.append(entry)
+                for t in thunks:
+                    yield t, entry
+
+        decoded = parallel_map_iter(
+            self._decode, chunk_stream(), workers=self.decode_workers,
+            maxsize=max(self.runner.prefetch, 1))
+        encode_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="sparkdl-encode")
+        # Backpressure for the overlap worker: un-encoded RAW outputs are
+        # full float32 chunks, so an encode slower than the device fetch
+        # (image-mode nhwcToStructs on a huge partition) must throttle the
+        # consumer loop before a partition's worth of raw output piles up
+        # on the host — the O(window · batchSize) contract. Encoded
+        # results are the compact final column form and may accumulate
+        # per pending partition, exactly as the per-partition design did.
+        backlog: collections.deque = collections.deque()
+        max_backlog = max(2, int(getattr(self.runner, "prefetch", 2)))
+        try:
+            for out, entry in self.runner.run_stream(decoded):
+                # Hand the Arrow encode to the overlap worker and go
+                # straight back to the device stream — the feed waits on
+                # encoding only past the bounded backlog.
+                while backlog and backlog[0].done():
+                    backlog.popleft()
+                if len(backlog) >= max_backlog:
+                    backlog.popleft().result()
+                fut = encode_pool.submit(self._encode, np.asarray(out))
+                backlog.append(fut)
+                entry["futs"].append(fut)
+                while pending and \
+                        len(pending[0]["futs"]) == pending[0]["n_chunks"]:
+                    yield self._finish(pending.popleft())
+            while pending:
+                yield self._finish(pending.popleft())
+        finally:
+            encode_pool.shutdown(wait=False, cancel_futures=True)

@@ -12,7 +12,7 @@ from __future__ import annotations
 import numpy as np
 import pyarrow as pa
 
-from ..core.frame import DataFrame, _length_preserving, _set_column
+from ..core.frame import DataFrame
 from ..core.params import (HasBatchSize, HasInputCol, HasOutputCol, Param,
                            Params, TypeConverters, keyword_only)
 from ..core.pipeline import Transformer
@@ -89,6 +89,8 @@ class XlaTransformer(PicklesCallableParams, Transformer, HasInputCol,
         return runner
 
     def _transform(self, dataset: DataFrame) -> DataFrame:
+        from .streaming import StreamScorer
+        from .xla_image import emptyVectorColumn
         in_col = self.getInputCol()
         out_col = self.getOutputCol()
         batch_size = self.getBatchSize()
@@ -96,18 +98,19 @@ class XlaTransformer(PicklesCallableParams, Transformer, HasInputCol,
                  if self.isDefined(self.inputShape) else None)
         runner = self._get_runner()
 
-        def op(batch: pa.RecordBatch) -> pa.RecordBatch:
-            from .xla_image import emptyVectorColumn
-            if batch.num_rows == 0:
-                return _set_column(batch, out_col, emptyVectorColumn())
-            arr = columnToNdarray(batch.column(in_col), shape)
-            outs = list(runner.run(
-                arr[i:i + batch_size]
-                for i in range(0, len(arr), batch_size)))
-            result = np.concatenate([np.asarray(o) for o in outs], axis=0)
-            return _set_column(batch, out_col, arrayColumnToArrow(result))
+        def chunk_thunks(batch: pa.RecordBatch) -> list:
+            # Decode per device chunk on the pool (zero-copy Arrow→ndarray
+            # per slice) — peak host memory O(batchSize), and the chunks
+            # of every partition ride ONE device stream (no window drain
+            # at partition boundaries).
+            col = batch.column(in_col)
+            return [
+                lambda i=i: columnToNdarray(col.slice(i, batch_size), shape)
+                for i in range(0, batch.num_rows, batch_size)]
 
-        return dataset.mapBatches(_length_preserving(op))
+        return dataset.mapStream(StreamScorer(
+            runner, out_col, chunk_thunks, arrayColumnToArrow,
+            emptyVectorColumn))
 
     _pickled_params = ("fn",)
 

@@ -5,7 +5,9 @@ The TFImageTransformer of this framework (reference:
 reference accepted an arbitrary TF graph and executed it per partition through
 TensorFrames, this transformer accepts an arbitrary **jittable function**
 ``fn(batch)`` over NHWC float batches and executes it as one XLA program on
-the TPU, fed by the pad/prefetch/unpad BatchRunner pipeline.
+the TPU, fed by the streaming scoring engine (``transformers/streaming.py``):
+parallel host decode → pad/prefetch → one continuous cross-partition device
+stream → overlap-worker Arrow encode.
 
 The whole preprocessing+model chain lives inside one jit boundary, so XLA
 fuses elementwise preprocessing into the model's first convolution — the
@@ -18,13 +20,14 @@ from __future__ import annotations
 import numpy as np
 import pyarrow as pa
 
-from ..core.frame import DataFrame, _length_preserving, _set_column
+from ..core.frame import DataFrame
 from ..core.params import (HasBatchSize, HasInputCol, HasOutputCol, Param,
                            Params, TypeConverters, keyword_only)
 from ..core.pipeline import Transformer
-from ..core.runtime import BatchRunner, background_iter
+from ..core.runtime import BatchRunner
 from ..image import imageIO
 from .payloads import PicklesCallableParams
+from .streaming import StreamScorer
 
 
 def arrayColumnToArrow(result: np.ndarray) -> pa.Array:
@@ -144,16 +147,12 @@ class XlaImageTransformer(PicklesCallableParams, Transformer, HasInputCol,
         batch_size = self.getBatchSize()
         runner = self._get_runner()
 
-        def op(batch: pa.RecordBatch) -> pa.RecordBatch:
-            if batch.num_rows == 0:
-                empty = (pa.array([], type=imageIO.imageSchema)
-                         if out_mode == "image" else emptyVectorColumn())
-                return _set_column(batch, out_col, empty)
+        def chunk_thunks(batch: pa.RecordBatch) -> list:
             # One Arrow partition may exceed the device batch: decode AND
             # run per device-chunk, so peak host memory is O(batchSize)
             # decoded pixels, not O(partition) (round-1 verdict weak #4).
-            # The generator keeps the decode of chunk i+1 interleaved with
-            # the device execution of chunk i via the runner's prefetch.
+            # Each thunk runs on the parallel decode pool
+            # (SPARKDL_DECODE_WORKERS) while earlier chunks execute.
             col = batch.column(in_col)
             h, w = size
             if h is None or w is None:
@@ -165,51 +164,35 @@ class XlaImageTransformer(PicklesCallableParams, Transformer, HasInputCol,
             # uint8 feed (the runner casts on-device — 4x fewer bytes over
             # the host→HBM link) when every row stores uint8 pixels; float-
             # mode (CV_32F*) columns keep a float32 feed, which the runner's
-            # in-graph astype(f32) passes through untouched. Decoded ahead
-            # on a background thread so host decode overlaps device compute.
+            # in-graph astype(f32) passes through untouched.
             modes = col.field("mode").to_numpy(zero_copy_only=False)
             feed_dtype = (np.uint8 if all(
                 imageIO.ocvTypeByMode(int(m)).dtype == "uint8"
                 for m in np.unique(modes)) else np.float32)
-            chunks = background_iter(
-                (imageIO.imageColumnToNHWC(
+            return [
+                lambda i=i: imageIO.imageColumnToNHWC(
                     col.slice(i, batch_size), h, w, channelOrder=order,
                     dtype=feed_dtype)
-                 for i in range(0, batch.num_rows, batch_size)),
-                maxsize=runner.prefetch)
-            # Convert each device chunk to its FINAL Arrow representation
-            # as it lands — the float32 model output for the whole
-            # partition never materializes on the host (round-3 verdict
-            # Next #8: output-side host memory). Peak output-side memory =
-            # one float32 chunk + the (uint8-struct / packed-list) column
-            # itself, instead of 2x the partition in float32.
-            pieces = []
-            for o in runner.run(chunks):
-                result = np.asarray(o)
-                if out_mode == "image":
-                    structs = imageIO.nhwcToStructs(
-                        np.clip(result, 0, 255).astype(np.uint8),
-                        channelOrder=order)
-                    pieces.append(pa.array(structs,
-                                           type=imageIO.imageSchema))
-                else:
-                    pieces.append(arrayColumnToArrow(result))
-            if len(pieces) == 1:
-                out_arr = pieces[0]
-            else:
-                # int32 list offsets overflow past 2**31 total values —
-                # promote every piece to large_list before concat (the
-                # single-array path got this via arrayColumnToArrow).
-                total = sum(len(p.values) if isinstance(
-                    p, (pa.ListArray, pa.LargeListArray)) else 0
-                    for p in pieces)
-                if total > np.iinfo(np.int32).max:
-                    pieces = [p.cast(pa.large_list(p.type.value_type))
-                              if isinstance(p, pa.ListArray) else p
-                              for p in pieces]
-                out_arr = pa.concat_arrays(pieces)
-            return _set_column(batch, out_col, out_arr)
+                for i in range(0, batch.num_rows, batch_size)]
 
-        return dataset.mapBatches(_length_preserving(op))
+        # Each device chunk converts to its FINAL Arrow representation on
+        # the scorer's overlap worker as it lands — the float32 model
+        # output for a whole partition never materializes on the host, and
+        # the device feed never waits on the conversion.
+        if out_mode == "image":
+            def encode(result: np.ndarray) -> pa.Array:
+                structs = imageIO.nhwcToStructs(
+                    np.clip(result, 0, 255).astype(np.uint8),
+                    channelOrder=order)
+                return pa.array(structs, type=imageIO.imageSchema)
+
+            def empty_array() -> pa.Array:
+                return pa.array([], type=imageIO.imageSchema)
+        else:
+            encode = arrayColumnToArrow
+            empty_array = emptyVectorColumn
+
+        return dataset.mapStream(StreamScorer(
+            runner, out_col, chunk_thunks, encode, empty_array))
 
     _pickled_params = ("fn",)

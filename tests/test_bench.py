@@ -113,8 +113,13 @@ def test_all_metric_legs_run_end_to_end_tiny_cpu():
     errs = [k for k in extra if k.endswith("_error")]
     assert not errs, {k: extra[k] for k in errs}
     for key in ("mfu", "featurizer_rows_per_sec", "featurizer_breakdown",
-                "bert_tokens_s_chip", "gen_e2e_tokens_s", "flash"):
+                "inference", "bert_tokens_s_chip", "gen_e2e_tokens_s",
+                "flash"):
         assert key in extra, f"leg output missing {key}: {sorted(extra)}"
+    # the inference-throughput record (ISSUE 3): rate + per-stage spans
+    assert extra["inference"]["rows_per_sec"] > 0
+    assert {"decode", "dispatch", "fetch", "encode"} <= \
+        set(extra["inference"]["stage_seconds"]), extra["inference"]
     assert "gen_eos_error" not in extra
     # mid-stream EOS exit: the loop iterated, then stopped early
     assert 0 < extra["gen_eos_steps"] < extra["gen_new_tokens"], extra

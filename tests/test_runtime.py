@@ -192,6 +192,140 @@ def test_background_iter_cancellation_releases_producer():
     assert len(produced) < 100, "producer ran unbounded after close"
 
 
+def test_parallel_map_iter_order_error_and_inline():
+    """The decode pool preserves order under parallelism, re-raises at the
+    consumption point, and workers<=0 degrades to inline map."""
+    import time as _time
+
+    def slow_sq(i):
+        _time.sleep(0.01 * ((i * 7) % 3))  # jittered: tempt reordering
+        return i * i
+
+    got = list(runtime.parallel_map_iter(slow_sq, range(20), workers=4))
+    assert got == [i * i for i in range(20)]
+    assert list(runtime.parallel_map_iter(slow_sq, range(5), workers=0)) \
+        == [i * i for i in range(5)]
+
+    def boom(i):
+        if i == 3:
+            raise RuntimeError("decode failed")
+        return i
+
+    it = runtime.parallel_map_iter(boom, range(6), workers=2)
+    assert next(it) == 0
+    with pytest.raises(RuntimeError, match="decode failed"):
+        list(it)
+
+
+def test_parallel_map_iter_env_default(monkeypatch):
+    monkeypatch.setenv("SPARKDL_DECODE_WORKERS", "3")
+    assert runtime.decode_workers_default() == 3
+    monkeypatch.setenv("SPARKDL_DECODE_WORKERS", "junk")
+    assert runtime.decode_workers_default() == 2
+
+
+def test_run_stream_threads_meta_and_matches_run():
+    """run_stream carries host-side metadata through the window untouched
+    and unpads exactly like run()."""
+    fn = lambda x: x + 1.0
+    runner = runtime.BatchRunner(fn, batch_size=4)
+    batches = [np.full((3, 2), i, np.float32) for i in range(6)]
+    metas = [("part", i) for i in range(6)]
+    out = list(runner.run_stream(zip(batches, metas)))
+    assert [m for _, m in out] == metas
+    for i, (o, _) in enumerate(out):
+        assert o.shape == (3, 2)
+        np.testing.assert_allclose(o, i + 1.0)
+    # meta-less wrapper agrees
+    out2 = list(runner.run(iter(batches)))
+    for (o, _), o2 in zip(out, out2):
+        np.testing.assert_array_equal(o, o2)
+
+
+def test_run_stream_no_drain_at_partition_boundaries():
+    """THE no-drain pin (ISSUE 3 acceptance): with a full prefetch window,
+    dispatches run ahead across 'partition' boundaries — before the FIRST
+    output is even fetched, chunks of later partitions have already been
+    dispatched. The old per-partition run() dispatched exactly one chunk
+    per partition before yielding its output."""
+    runner = runtime.BatchRunner(lambda x: x * 2.0, batch_size=2,
+                                 prefetch=2)
+    dispatched = []
+    inner = runner._jitted
+    runner._jitted = lambda b: (dispatched.append(1), inner(b))[1]
+    # 5 single-chunk "partitions"
+    stream = runner.run_stream(
+        (np.full((2, 2), i, np.float32), i) for i in range(5))
+    out0, meta0 = next(stream)
+    assert meta0 == 0
+    np.testing.assert_allclose(out0, 0.0)
+    # window depth prefetch=2 → chunks from partitions 0,1,2 (and with the
+    # put lookahead possibly 3) dispatched before partition 0's output was
+    # yielded: the window crossed ≥2 partition boundaries without draining.
+    assert len(dispatched) >= 3, dispatched
+    rest = list(stream)
+    assert [m for _, m in rest] == [1, 2, 3, 4]
+    assert len(dispatched) == 5
+
+
+def test_compile_cache_emits_recompile_events():
+    from sparkdl_tpu.runner import events
+    rec = events.reset()
+    try:
+        cache = runtime.CompileCache()
+        f = cache.get("probe_fn", lambda x: x * 2)
+        f(jnp.ones((2,)))
+        f(jnp.ones((2,)))
+        f(jnp.ones((3,)))
+        names = [e["name"] for e in rec.tail()]
+        assert names.count("recompile") == 2
+        ev = [e for e in rec.tail() if e["name"] == "recompile"][-1]
+        assert ev["fn"] == "probe_fn" and ev["misses"] == 2
+        assert cache.snapshot() == {"hits": 1, "misses": 2}
+    finally:
+        events.reset()
+
+
+def test_enable_persistent_compile_cache(tmp_path, monkeypatch):
+    """SPARKDL_COMPILE_CACHE wiring: the jax config points at the dir,
+    min-compile-time drops to 0 (small programs cache too), and a compile
+    through the enabled cache lands in the stats + the event stream as a
+    compile_cache miss (the first process pays; a later process hits —
+    pinned end-to-end by scripts/score_smoke.py, slow)."""
+    from sparkdl_tpu.runner import events
+    cache_dir = str(tmp_path / "xla_cache")
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    rec = events.reset()
+    try:
+        monkeypatch.setenv(runtime.COMPILE_CACHE_ENV, cache_dir)
+        assert runtime.enable_persistent_compile_cache() == cache_dir
+        assert jax.config.jax_compilation_cache_dir == cache_dir
+        assert jax.config.jax_persistent_cache_min_compile_time_secs == 0
+        before = runtime.persistent_cache_stats()
+        assert before["dir"] == cache_dir
+        # unique shape → fresh compile → persistent-cache miss recorded
+        jax.jit(lambda x: (x * 3 + 1).sum())(jnp.arange(37.0))
+        stats = runtime.persistent_cache_stats()
+        assert stats["misses"] > before["misses"]
+        assert any(e["name"] == "compile_cache"
+                   and e.get("outcome") == "miss" for e in rec.tail())
+        # a bad path degrades to no-cache instead of raising (a config
+        # typo must never kill every importing process)
+        bad = str(tmp_path / "not_a_dir")
+        open(bad, "w").close()
+        assert runtime.enable_persistent_compile_cache(
+            bad + "/cache") is None
+    finally:
+        events.reset()
+        # disarm: the listener goes quiet and stale telemetry clears
+        runtime.disable_persistent_compile_cache()
+        assert runtime.persistent_cache_stats()["dir"] is None
+        jax.config.update("jax_compilation_cache_dir", prev_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          prev_min)
+
+
 def test_make_mesh_topology_aware_dispatch(monkeypatch):
     """On multi-chip TPU device sets make_mesh must route through
     mesh_utils.create_device_mesh (ICI-torus-aware placement — BASELINE
