@@ -1283,10 +1283,11 @@ def main():
             # would just be noise in every leg).
             from sparkdl_tpu.runner.metrics import (global_step_stats,
                                                     run_stats)
-            snap = run_stats.snapshot()
-            if isinstance(result, dict) and (snap["restarts"] or
-                                             snap["faults_injected"]):
-                result.setdefault("failure_stats", snap)
+            # degraded() also covers the ISSUE 4 data-plane counters
+            # (rows_quarantined / dispatch_retries / checkpoint_rollbacks)
+            # so a leg that survived faults carries its ledger.
+            if isinstance(result, dict) and run_stats.degraded():
+                result.setdefault("failure_stats", run_stats.snapshot())
             # Step-time percentiles (ISSUE 2): whatever trained through a
             # metered loop in this worker recorded into the process-wide
             # reservoir — p50/p95/p99/max ride the record next to the

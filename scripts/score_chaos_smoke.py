@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Scoring chaos smoke: injected decode faults + an injected dispatch fault
+through the fault-tolerant streaming scorer, on CPU (ISSUE 4).
+
+Three passes over one synthetic image frame (13 partitions, one emptied
+mid-stream by a filter):
+
+1. **Clean run** — no chaos; per-origin feature vectors are the ground
+   truth.
+2. **Decode-fault run** — a seeded ``decode``-site fault plan fails a
+   fraction of chunk/row decodes; ``onError='quarantine'`` must complete
+   the job, dead-letter exactly the failing rows (error_class =
+   ``InjectedFatal``), and score every surviving row **bit-identically**
+   to the clean run. Quarantine counts must agree across the dead-letter
+   sink, input-minus-output, and ``run_stats.rows_quarantined``.
+3. **Dispatch-retry run** — a once-only ``dispatch`` preemption; the
+   bounded retry must absorb it (job completes, all rows scored, a
+   ``retry`` flight-recorder event on record).
+
+Prints one JSON line and exits 0 on success.
+
+Run: ``JAX_PLATFORMS=cpu python scripts/score_chaos_smoke.py``
+"""
+
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("SPARKDL_DISPATCH_BACKOFF_S", "0.05")
+
+ROWS = int(os.environ.get("SCORE_CHAOS_ROWS", "104"))
+BATCH = int(os.environ.get("SCORE_CHAOS_BATCH", "8"))
+PARTS = int(os.environ.get("SCORE_CHAOS_PARTS", "13"))
+DECODE_FAULT_PROB = float(os.environ.get("SCORE_CHAOS_PROB", "0.25"))
+
+
+def main() -> int:
+    import numpy as np
+    import pyarrow as pa
+
+    import sparkdl_tpu as sdl
+    from sparkdl_tpu.image import imageIO
+    from sparkdl_tpu.runner import chaos, events, metrics
+    from sparkdl_tpu.runner.chaos import Fault, FaultPlan
+
+    rng = np.random.RandomState(0)
+    structs = [imageIO.imageArrayToStruct(
+        rng.randint(0, 256, size=(12, 12, 3)).astype(np.uint8),
+        origin=f"img_{i}") for i in range(ROWS)]
+    df_full = sdl.DataFrame.fromArrow(
+        pa.table({"image": pa.array(structs, type=imageIO.imageSchema)}),
+        numPartitions=PARTS)
+    # Empty one partition mid-stream: rows of partition 6 are filtered
+    # out, so the engine must carry an empty partition without desyncing
+    # partition reassembly (the acceptance's "incl. empty partitions").
+    per = -(-ROWS // PARTS)
+    dropped = set(range(6 * per, 7 * per))
+    df = df_full.filter(
+        lambda r: int(r.image["origin"].split("_")[1]) not in dropped)
+    expected_origins = [f"img_{i}" for i in range(ROWS) if i not in dropped]
+
+    def scorer(on_error):
+        return sdl.XlaImageTransformer(
+            inputCol="image", outputCol="features",
+            fn=lambda b: b.mean(axis=(1, 2)), inputSize=(8, 8),
+            batchSize=BATCH, onError=on_error)
+
+    def score(t):
+        rows = t.transform(df).collect()
+        return {r.image["origin"]: np.asarray(r.features, np.float32)
+                for r in rows}
+
+    # -- 1. clean ground truth --------------------------------------------
+    chaos.uninstall()
+    metrics.run_stats.reset()
+    clean = score(scorer("raise"))
+    assert len(clean) == len(expected_origins), \
+        f"clean run scored {len(clean)}/{len(expected_origins)}"
+
+    # -- 2. injected decode faults + quarantine ---------------------------
+    metrics.run_stats.reset()
+    events.reset(ring_size=8192)
+    chaos.install(FaultPlan(
+        [Fault("decode", "fatal", prob=DECODE_FAULT_PROB, once=False)],
+        seed=7))
+    t = scorer("quarantine")
+    try:
+        faulted = score(t)
+    finally:
+        chaos.uninstall()
+    dead = t.deadLetters()
+    quarantined = dead.num_rows
+    scored = len(faulted)
+    survivors_identical = all(
+        np.array_equal(clean[o], faulted[o]) for o in faulted)
+    counts_agree = (
+        scored + quarantined == len(expected_origins)
+        and quarantined == metrics.run_stats.rows_quarantined)
+    classes = set(dead.column("error_class").to_pylist())
+    dead_letter_ok = (quarantined > 0 and classes == {"InjectedFatal"}
+                      and dead.column_names[-2:] == ["error_class", "error"])
+
+    # -- 3. transient dispatch fault absorbed by the bounded retry --------
+    metrics.run_stats.reset()
+    rec = events.reset(ring_size=8192)
+    chaos.install(FaultPlan(
+        [Fault("dispatch", "preempt", prob=1.0, once=True)], seed=11))
+    try:
+        retried = score(scorer("raise"))
+    finally:
+        chaos.uninstall()
+    retry_events = [e for e in rec.tail() if e["name"] == "retry"]
+    retry_ok = (len(retried) == len(expected_origins)
+                and len(retry_events) >= 1
+                and metrics.run_stats.dispatch_retries >= 1)
+
+    ok = (survivors_identical and counts_agree and dead_letter_ok
+          and retry_ok)
+    print(json.dumps({
+        "ok": ok,
+        "rows": len(expected_origins),
+        "scored": scored,
+        "quarantined": quarantined,
+        "quarantine_counts_agree": counts_agree,
+        "survivors_bit_identical": survivors_identical,
+        "dead_letter_classes": sorted(classes),
+        "dispatch_retry_events": len(retry_events),
+        "dispatch_retry_ok": retry_ok,
+        "fault_tolerance": metrics.fault_tolerance_summary(),
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -122,10 +122,13 @@ class DataFrame:
         return DataFrame(self._partitions, self._ops + (fn,))
 
     def mapStream(self, fn: Callable[[Iterator[pa.RecordBatch]],
-                                     Iterator[pa.RecordBatch]]) -> "DataFrame":
+                                     Iterator[pa.RecordBatch]],
+                  changes_length: bool = False) -> "DataFrame":
         """Stream-level mapBatches: ``fn`` sees the iterator of ALL
         partition batches at materialization time and yields exactly one
-        same-length output batch per input batch, in order.
+        output batch per input batch, in order — same-length unless
+        ``changes_length`` (a quarantining scorer drops dead-lettered
+        rows, so ``limit``/``count`` must give up their lazy fast paths).
 
         This is the primitive behind the streaming inference engine: a
         per-batch op (``mapBatches``) is re-invoked per partition, so any
@@ -134,7 +137,8 @@ class DataFrame:
         and can keep one continuous batch stream flowing through the
         device across partitions. Still lazy — the op chain composes and
         runs single-pass like every other narrow op."""
-        return DataFrame(self._partitions, self._ops + (_StreamOp(fn),))
+        return DataFrame(self._partitions,
+                         self._ops + (_StreamOp(fn, changes_length),))
 
     def select(self, *cols: str) -> "DataFrame":
         names = list(cols)
@@ -472,16 +476,17 @@ class DataFrame:
 
 class _StreamOp:
     """A stream-level op (see :meth:`DataFrame.mapStream`): ``fn`` maps the
-    whole partition-batch iterator, one same-length output batch per input
-    batch. Length-preserving by contract (so ``limit``/``count`` keep
-    their lazy fast paths) but NOT row-wise: it must see partition-sized
-    batches, never sub-partition slices."""
+    whole partition-batch iterator, one output batch per input batch.
+    Length-preserving by default (so ``limit``/``count`` keep their lazy
+    fast paths); a quarantining scorer passes ``changes_length=True``.
+    Never row-wise: it must see partition-sized batches, not
+    sub-partition slices."""
 
-    __slots__ = ("fn",)
-    _changes_length = False
+    __slots__ = ("fn", "_changes_length")
 
-    def __init__(self, fn):
+    def __init__(self, fn, changes_length: bool = False):
         self.fn = fn
+        self._changes_length = changes_length
 
 
 def _op_changes_length(op) -> bool:

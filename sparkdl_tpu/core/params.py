@@ -379,6 +379,40 @@ class HasBatchSize(Params):
         return self.getOrDefault(self.batchSize)
 
 
+class HasOnError(Params):
+    """Scoring failure mode for host-side decode/payload errors (ISSUE 4):
+    ``'raise'`` (default — one corrupt row kills the job, the pre-fault-
+    tolerance behavior) or ``'quarantine'`` (bad rows route to a
+    dead-letter side output with ``error_class``/``error`` columns —
+    Spark-style task isolation; read it back via ``deadLetters()`` after
+    materialization, bounded by ``SPARKDL_MAX_QUARANTINE_FRAC``)."""
+    onError = Param(Params, "onError", "host-side decode failure mode: "
+                    "'raise' or 'quarantine' (dead-letter side output)",
+                    TypeConverters.toString)
+
+    def setOnError(self, value):
+        if value not in ("raise", "quarantine"):
+            raise ValueError(f"onError must be 'raise' or 'quarantine', "
+                             f"got {value!r}")
+        return self._set(onError=value)
+
+    def getOnError(self):
+        return (self.getOrDefault(self.onError)
+                if self.isSet(self.onError) or self.hasDefault(self.onError)
+                else "raise")
+
+    def deadLetters(self):
+        """The dead-letter output of this stage's most recent materialized
+        ``transform`` pass that quarantined at least one row: a
+        ``pyarrow.Table`` of the quarantined input rows +
+        ``error_class``/``error`` columns, with a stable schema even when
+        empty (clean passes — including the 1-row schema probe
+        ``DataFrame.schema`` runs — never wipe it). None before any
+        quarantining transform ran."""
+        sink = getattr(self, "_quarantine_sink", None)
+        return sink.to_table() if sink is not None else None
+
+
 class HasSeed(Params):
     seed = Param(Params, "seed", "PRNG seed (threaded through jax.random keys)",
                  TypeConverters.toInt)

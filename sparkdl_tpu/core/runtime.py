@@ -27,6 +27,7 @@ import math
 import os
 import queue as queue_mod
 import threading
+import time
 from typing import Any, Callable, Iterable, Iterator, Sequence
 
 import jax
@@ -41,6 +42,21 @@ def _events():
     siblings; resolving it per call is a sys.modules hit after the first)."""
     from sparkdl_tpu.runner import events
     return events
+
+
+def _chaos():
+    from sparkdl_tpu.runner import chaos
+    return chaos
+
+
+def _failures():
+    from sparkdl_tpu.runner import failures
+    return failures
+
+
+def _run_stats():
+    from sparkdl_tpu.runner import metrics
+    return metrics.run_stats
 
 
 def devices() -> list:
@@ -314,6 +330,64 @@ def background_iter(iterator: Iterable, maxsize: int = 2) -> Iterator:
         cancelled.set()
 
 
+def dispatch_retries_default() -> int:
+    """Bounded retry budget for transient dispatch/fetch errors in
+    ``BatchRunner.run_stream`` (``SPARKDL_DISPATCH_RETRIES``, default 2;
+    0 disables retries AND releases the per-slot host batch copy the
+    re-dispatch path needs — the leanest-memory mode)."""
+    try:
+        return max(0, int(os.environ.get("SPARKDL_DISPATCH_RETRIES", "2")))
+    except ValueError:
+        return 2
+
+
+def dispatch_backoff_default() -> float:
+    """Base backoff (seconds) between dispatch/fetch retries; doubles per
+    attempt (``SPARKDL_DISPATCH_BACKOFF_S``, default 0.2)."""
+    try:
+        return max(0.0, float(
+            os.environ.get("SPARKDL_DISPATCH_BACKOFF_S", "0.2")))
+    except ValueError:
+        return 0.2
+
+
+def dispatch_timeout_default() -> float:
+    """Stall watchdog on the in-flight window: a blocking fetch that makes
+    no progress for this many seconds raises a classified
+    ``ScoringStallError`` naming the stage instead of hanging the job
+    forever (``SPARKDL_DISPATCH_TIMEOUT_S``; default 0 = disabled — the
+    watchdog costs one helper thread per fetch while armed)."""
+    try:
+        return float(os.environ.get("SPARKDL_DISPATCH_TIMEOUT_S", "0"))
+    except ValueError:
+        return 0.0
+
+
+def _call_with_timeout(fn: Callable, timeout_s: float, stage: str):
+    """Run ``fn`` on a helper thread, bounded by ``timeout_s``. On timeout
+    the (possibly wedged) call is abandoned on its daemon thread and a
+    classified :class:`ScoringStallError` names the stage — turning a
+    silent device/interconnect hang into a supervisable failure."""
+    result: dict = {}
+    done = threading.Event()
+
+    def work():
+        try:
+            result["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            result["error"] = e
+        finally:
+            done.set()
+
+    threading.Thread(target=work, daemon=True,
+                     name="sparkdl-fetch-watchdog").start()
+    if not done.wait(timeout_s):
+        raise _failures().ScoringStallError(stage, timeout_s)
+    if "error" in result:
+        raise result["error"]
+    return result["value"]
+
+
 def decode_workers_default() -> int:
     """Host decode parallelism for the inference feed
     (``SPARKDL_DECODE_WORKERS``; default 2). The Arrow→NHWC pack and PIL
@@ -442,16 +516,33 @@ class BatchRunner:
         ``device_put``) spans the WHOLE stream: feeding every partition of
         a dataset through one call keeps the device busy across partition
         boundaries instead of draining per partition. ``n_valid`` threads
-        through the window next to each batch — no ``itertools.tee``, so
-        no padded host copies stay pinned alongside their device copies.
+        through the window next to each batch.
+
+        Fault tolerance (ISSUE 4): transient *retryable* dispatch/fetch
+        errors (``failures.classify_exception`` — UNAVAILABLE, preemption,
+        connection flakes) are retried up to ``SPARKDL_DISPATCH_RETRIES``
+        times with exponential backoff (``SPARKDL_DISPATCH_BACKOFF_S``),
+        each retry re-putting the batch from its host copy and emitting a
+        ``retry`` flight-recorder event; exhaustion (or a fatal error)
+        emits ``give_up`` and raises :class:`ScoringStageError` naming the
+        stage. The retry path pins one padded HOST copy per window slot —
+        ``SPARKDL_DISPATCH_RETRIES=0`` disables retries and restores the
+        no-host-copy lean mode. ``SPARKDL_DISPATCH_TIMEOUT_S`` > 0 arms a
+        stall watchdog on the blocking fetch: no progress for that long
+        raises a classified ``ScoringStallError`` instead of hanging.
         """
         ev = _events()
+        chaos = _chaos()
+        retries = dispatch_retries_default()
+        backoff_s = dispatch_backoff_default()
+        stall_s = dispatch_timeout_default()
+        batch_ids = itertools.count()
 
         def staged():
             for b, meta in batches:
                 with ev.span("pad"):
                     padded, n = pad_batch(b, self.batch_size)
-                yield padded, n, meta
+                yield padded, n, meta, next(batch_ids)
 
         put = _put_fn(self._sharding)
 
@@ -459,24 +550,19 @@ class BatchRunner:
             # n/meta ride each window slot (never tee'd) through the
             # shared submit-ahead window — same contract as
             # prefetch_to_device, with SPARKDL_TRANSFER_WORKERS pooling.
-            padded, n, meta = slot
+            # The padded host batch is kept only while retries are
+            # enabled: it is what the re-dispatch path re-puts.
+            padded, n, meta, idx = slot
             with ev.span("put"):
-                return put(padded), n, meta
+                return put(padded), (padded if retries else None), n, \
+                    meta, idx
 
         def put_stream():
             return _windowed_apply(put_slot, staged(), self.prefetch,
                                    transfer_workers_default(),
                                    "sparkdl-put")
 
-        def fetch(item):
-            out, n, meta = item
-            with ev.span("fetch", rows=n):
-                out_np = jax.tree_util.tree_map(np.asarray, out)
-                return (jax.tree_util.tree_map(lambda x: x[:n], out_np),
-                        meta)
-
-        window: collections.deque = collections.deque()
-        for dev_batch, n, meta in put_stream():
+        def dispatch_once(dev_batch, n, idx):
             # Signature accounting BEFORE the dispatch: a pad bug or
             # mixed-shape stream shows up as `recompile` events (and in
             # meter.summary()["compile_cache"]) instead of a silent
@@ -486,12 +572,106 @@ class BatchRunner:
                 tuple((leaf.shape, str(leaf.dtype))
                       for leaf in jax.tree_util.tree_leaves(dev_batch))))
             with ev.span("dispatch", rows=n):
-                out = self._jitted(dev_batch)
+                chaos.fire("dispatch", step=idx)
+                if stall_s > 0:
+                    # On synchronous backends (CPU; some pathological
+                    # compiles) a hang blocks the dispatch call itself and
+                    # never reaches the fetch — the armed watchdog covers
+                    # both ends of the window.
+                    out = _call_with_timeout(
+                        lambda: self._jitted(dev_batch), stall_s,
+                        "dispatch")
+                else:
+                    out = self._jitted(dev_batch)
                 # Start the device→host copy now; block only when popped.
                 for leaf in jax.tree_util.tree_leaves(out):
                     if hasattr(leaf, "copy_to_host_async"):
                         leaf.copy_to_host_async()
-            window.append((out, n, meta))
+            return out
+
+        def retry_or_raise(stage, exc, host, n, idx, state):
+            """One retry decision + (on retry) the serial re-put +
+            re-dispatch. Returns a fresh ``out``; raises the classified
+            stage error when the budget is spent or the error is fatal."""
+            failures = _failures()
+            while True:
+                kind = failures.classify_exception(exc)
+                if host is None or kind != "retryable" \
+                        or state["attempts"] > retries:
+                    ev.event("give_up", stage=stage,
+                             attempts=state["attempts"], kind=kind,
+                             error=f"{type(exc).__name__}: {exc}"[:300],
+                             batch=idx)
+                    if kind == "retryable" and host is not None:
+                        _run_stats().record_retry(giveup=True)
+                    raise failures.ScoringStageError(
+                        stage, state["attempts"], exc) from exc
+                delay = backoff_s * (2 ** (state["attempts"] - 1))
+                ev.event("retry", stage=stage, attempt=state["attempts"],
+                         delay_s=round(delay, 3),
+                         error=f"{type(exc).__name__}: {exc}"[:300],
+                         batch=idx)
+                _run_stats().record_retry()
+                state["attempts"] += 1
+                if delay:
+                    time.sleep(delay)
+                try:
+                    # Rare path, so serial: fresh device buffers from the
+                    # host copy (the originals may be donated/poisoned),
+                    # then re-dispatch.
+                    with ev.span("put"):
+                        dev = put(host)
+                    return dispatch_once(dev, n, idx)
+                except failures.ScoringStallError:
+                    # The retry itself wedged: same no-re-dispatch rule
+                    # as the top-level stalls — surface it NOW instead of
+                    # burning the remaining budget stall_s at a time.
+                    ev.event("give_up", stage=stage, stalled=True,
+                             timeout_s=stall_s, batch=idx)
+                    raise
+                except Exception as e:  # noqa: BLE001 — reclassified above
+                    exc = e
+
+        def fetch(slot):
+            out, host, n, meta, idx, state = slot
+            failures = _failures()
+            while True:
+                try:
+                    with ev.span("fetch", rows=n):
+                        if stall_s > 0:
+                            out_np = _call_with_timeout(
+                                lambda: jax.tree_util.tree_map(
+                                    np.asarray, out), stall_s, "fetch")
+                        else:
+                            out_np = jax.tree_util.tree_map(np.asarray, out)
+                    return (jax.tree_util.tree_map(lambda x: x[:n], out_np),
+                            meta)
+                except failures.ScoringStallError:
+                    # A wedged fetch is not fixed by re-dispatching onto
+                    # the same wedged device — surface it for the
+                    # process-level supervisor (classified retryable).
+                    ev.event("give_up", stage="fetch", stalled=True,
+                             timeout_s=stall_s, batch=idx)
+                    raise
+                except Exception as e:  # noqa: BLE001 — reclassified
+                    # Async device errors materialize here; a retry must
+                    # redo put+dispatch for this batch, then re-fetch.
+                    out = retry_or_raise("fetch", e, host, n, idx, state)
+
+        window: collections.deque = collections.deque()
+        for dev_batch, host, n, meta, idx in put_stream():
+            state = {"attempts": 1}
+            try:
+                out = dispatch_once(dev_batch, n, idx)
+            except _failures().ScoringStallError:
+                # A wedged dispatch is not fixed by re-dispatching onto
+                # the same wedged device (same rule as the fetch stall).
+                ev.event("give_up", stage="dispatch", stalled=True,
+                         timeout_s=stall_s, batch=idx)
+                raise
+            except Exception as e:  # noqa: BLE001 — reclassified
+                out = retry_or_raise("dispatch", e, host, n, idx, state)
+            window.append((out, host, n, meta, idx, state))
             if len(window) > self.prefetch:
                 yield fetch(window.popleft())
         while window:

@@ -14,10 +14,12 @@ step-time percentiles and MFU in ``ThroughputMeter.summary()``.
 from . import events
 from .chaos import Fault, FaultPlan, InjectedFatal, InjectedFault, \
     InjectedPreemption
-from .checkpoint import CheckpointManager, load_portable, save_portable
+from .checkpoint import CheckpointCorruptionError, CheckpointManager, \
+    load_portable, save_portable
 from .events import FlightRecorder, Timer, enable_flight_recorder, \
     merge_timeline
-from .failures import TrainingDivergedError, classify_exception, \
+from .failures import QuarantineOverflowError, ScoringStageError, \
+    ScoringStallError, TrainingDivergedError, classify_exception, \
     classify_text, diagnose_context, exception_summary, is_retryable
 from .launcher import GangFailure, SuperviseResult, launch, supervise
 from .metrics import MetricsLogger, StepTimeStats, ThroughputMeter, \
@@ -37,9 +39,11 @@ __all__ = [
     "XlaRunner", "HorovodRunner", "RunnerContext", "current_context",
     "TrainState", "make_train_step", "make_shard_map_step", "make_eval_step",
     "state_sharding", "softmax_cross_entropy_loss", "bn_classifier_loss",
-    "CheckpointManager", "save_portable", "load_portable",
+    "CheckpointManager", "CheckpointCorruptionError", "save_portable",
+    "load_portable",
     "classify_exception", "classify_text", "is_retryable",
-    "diagnose_context", "TrainingDivergedError",
+    "diagnose_context", "TrainingDivergedError", "QuarantineOverflowError",
+    "ScoringStallError", "ScoringStageError",
     "Fault", "FaultPlan", "InjectedFault", "InjectedPreemption",
     "InjectedFatal",
     "launch", "supervise", "GangFailure", "SuperviseResult",

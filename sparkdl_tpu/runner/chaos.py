@@ -17,8 +17,14 @@ Sites (where the runner consults the plan):
 - ``step_start``       — top of ``RunnerContext.fit``'s step loop
 - ``batch_fetch``      — after a host batch is drawn (``nan`` poisons it)
 - ``checkpoint_save``  — inside ``CheckpointManager.save``
+- ``checkpoint_restore`` — entry of ``CheckpointManager.restore``
+  (``corrupt`` truncates/flips the latest on-disk checkpoint here)
 - ``collective``       — entry of the hvd-compat ``allreduce``/``broadcast``
 - ``worker``           — entry of ``XlaRunner.run`` (worker program start)
+- ``decode``           — host-side decode of one scoring chunk/row
+  (``transformers/streaming.py``; exercises record quarantine)
+- ``dispatch``         — device dispatch of one scoring batch
+  (``BatchRunner.run_stream``; exercises the bounded dispatch retry)
 
 Kinds (what happens when a fault fires):
 
@@ -29,6 +35,9 @@ Kinds (what happens when a fault fires):
   only; exercises the train loop's divergence guard)
 - ``hang``    — sleep ``hang_s`` (exercises the heartbeat watchdog)
 - ``sigkill`` — ``SIGKILL`` the calling process (multi-process gang tests)
+- ``corrupt`` — truncate + bit-flip the newest checkpoint under the
+  firing site's ``path`` (``checkpoint_restore`` only; exercises manifest
+  verification and rollback-to-verified-step)
 
 Triggers are deterministic: ``at_step=N`` fires when the hook's step equals
 N; ``prob=p`` draws from a per-fault ``RandomState`` seeded from
@@ -53,13 +62,14 @@ import time
 
 __all__ = ["Fault", "FaultPlan", "InjectedFault", "InjectedPreemption",
            "InjectedFatal", "SITES", "KINDS", "CHAOS_ENV",
-           "fire", "install", "uninstall", "active_plan"]
+           "fire", "install", "uninstall", "active_plan",
+           "corrupt_latest_checkpoint"]
 
 CHAOS_ENV = "SPARKDL_CHAOS"
 
 SITES = ("step_start", "checkpoint_save", "batch_fetch", "collective",
-         "worker")
-KINDS = ("preempt", "fatal", "nan", "hang", "sigkill")
+         "worker", "decode", "dispatch", "checkpoint_restore")
+KINDS = ("preempt", "fatal", "nan", "hang", "sigkill", "corrupt")
 
 
 class InjectedFault(RuntimeError):
@@ -110,6 +120,9 @@ class Fault:
         if self.kind == "nan" and self.site != "batch_fetch":
             raise ValueError("kind='nan' only poisons batches — use "
                              "site='batch_fetch'")
+        if self.kind == "corrupt" and self.site != "checkpoint_restore":
+            raise ValueError("kind='corrupt' damages on-disk checkpoints — "
+                             "use site='checkpoint_restore'")
         if self.at_step is None and not (0.0 < self.prob <= 1.0):
             raise ValueError(f"fault needs a trigger: at_step=N or "
                              f"0 < prob <= 1 (got at_step=None, "
@@ -190,9 +203,13 @@ class FaultPlan:
             except OSError:
                 pass  # losing the marker degrades to per-process "once"
 
-    def fire(self, site: str, step: int | None = None, batch=None):
+    def fire(self, site: str, step: int | None = None, batch=None,
+             path: str | None = None):
         """Consult the plan at ``site``; returns ``batch`` (possibly
-        poisoned). Raising kinds raise; ``sigkill`` does not return."""
+        poisoned). Raising kinds raise; ``sigkill`` does not return.
+        ``path``: site-local filesystem context (the checkpoint directory
+        at ``checkpoint_restore`` — the ``corrupt`` kind damages the
+        newest step under it)."""
         out = batch
         for idx, f in enumerate(self.faults):
             if f.site != site:
@@ -208,7 +225,7 @@ class FaultPlan:
                 continue
             self._mark_fired(idx)
             _record_fault(site, f.kind, step)
-            out = _execute(f, site, step, out)
+            out = _execute(f, site, step, out, path=path)
         return out
 
 
@@ -235,7 +252,7 @@ def _record_fault(site: str, kind: str, step=None):
         pass
 
 
-def _execute(f: Fault, site: str, step, batch):
+def _execute(f: Fault, site: str, step, batch, path: str | None = None):
     where = f"chaos site={site}" + (f" step={step}" if step is not None
                                     else "")
     if f.kind == "preempt":
@@ -254,7 +271,50 @@ def _execute(f: Fault, site: str, step, batch):
         sys.stdout.flush()
         sys.stderr.flush()
         os.kill(os.getpid(), signal.SIGKILL)
+    if f.kind == "corrupt":
+        corrupt_latest_checkpoint(path)
+        return batch
     return batch
+
+
+def corrupt_latest_checkpoint(directory: str | None) -> list[str]:
+    """Damage the newest step under ``directory`` the way a SIGKILL
+    mid-async-save / bit-rot does: the largest file is bit-flipped AND
+    truncated to 3/4 of its length. Returns the damaged paths (empty when
+    there is nothing to damage — a corrupt fault firing before the first
+    save must not crash the restore path it is trying to exercise)."""
+    if not directory:
+        return []
+    try:
+        steps = [d for d in os.listdir(directory)
+                 if d.isdigit() and os.path.isdir(os.path.join(directory, d))]
+    except OSError:
+        return []
+    if not steps:
+        return []
+    step_dir = os.path.join(directory, max(steps, key=int))
+    files = []
+    for root, _, names in os.walk(step_dir):
+        for name in names:
+            p = os.path.join(root, name)
+            try:
+                files.append((os.path.getsize(p), p))
+            except OSError:
+                continue
+    files = [(s, p) for s, p in files if s > 0]
+    if not files:
+        return []
+    size, victim = max(files)
+    try:
+        with open(victim, "r+b") as fh:
+            fh.seek(size // 2)
+            b = fh.read(1)
+            fh.seek(size // 2)
+            fh.write(bytes([b[0] ^ 0xFF]) if b else b"\xff")
+            fh.truncate(max(1, size * 3 // 4))
+    except OSError:
+        return []
+    return [victim]
 
 
 def _poison(batch):
@@ -301,9 +361,10 @@ def active_plan() -> FaultPlan | None:
     return _ACTIVE
 
 
-def fire(site: str, step: int | None = None, batch=None):
+def fire(site: str, step: int | None = None, batch=None,
+         path: str | None = None):
     """The hook the runner calls at each site; no-op without a plan."""
     plan = active_plan()
     if plan is None:
         return batch
-    return plan.fire(site, step=step, batch=batch)
+    return plan.fire(site, step=step, batch=batch, path=path)

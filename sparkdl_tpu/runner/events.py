@@ -41,7 +41,8 @@ import time
 __all__ = ["FlightRecorder", "Timer", "RECORDER_DIR_ENV", "RING_ENV",
            "event", "span", "postmortem", "get_recorder", "reset",
            "enable_flight_recorder", "merge_timeline", "format_timeline",
-           "write_gang_postmortem", "clear_rank_files"]
+           "write_gang_postmortem", "clear_rank_files",
+           "collect_degradations"]
 
 log = logging.getLogger("sparkdl_tpu.runner")
 
@@ -348,6 +349,10 @@ _EVENT_FILE_RE = re.compile(r"events_rank(\d+)\.jsonl$")
 _POSTMORTEM_FILE_RE = re.compile(r"postmortem_rank(\d+)\.json$")
 GANG_TIMELINE_FILE = "gang_timeline.json"
 _MERGE_TAIL_BYTES = 1 << 20  # per-rank read cap when merging timelines
+# Survived-fault narrative (ISSUE 4): engaged-and-recovered machinery.
+# `give_up` is NOT here — an exhausted retry budget is failure evidence.
+_DEGRADATION_EVENTS = ("retry", "quarantine", "checkpoint_rollback",
+                       "checkpoint_quarantine")
 
 
 def atomic_write_json(path: str, obj) -> str:
@@ -449,6 +454,7 @@ def merge_timeline(event_dir: str, heartbeat_dir: str | None = None,
     errors: list[dict] = []  # (t, rank, site, step, error) candidates
     recovered: list[dict] = []  # in-process restarts: second-tier evidence
     last_restart: dict[int, float] = {}  # rank -> latest restart event t
+    degradations: list[dict] = []  # survived faults: rollback/retry/quarantine
     try:
         names = sorted(os.listdir(event_dir))
     except OSError:
@@ -500,6 +506,18 @@ def merge_timeline(event_dir: str, heartbeat_dir: str | None = None,
                                   "step": r.get("step"),
                                   "error": r.get("error"),
                                   "recovered": True})
+            elif r.get("name") in _DEGRADATION_EVENTS:
+                # Fault-tolerance machinery that ENGAGED AND RECOVERED
+                # (ISSUE 4): a dispatch retry, quarantined rows, a
+                # checkpoint rollback. Narrative, never failure evidence —
+                # these events carry error text describing what was
+                # survived, and must not outrank the fault that actually
+                # killed the gang.
+                degradations.append({"t": r.get("t", 0), "rank": rank,
+                                     "kind": r.get("name"),
+                                     "detail": {k: v for k, v in r.items()
+                                                if k not in ("t", "ph",
+                                                             "rank")}})
             elif "error" in r:
                 errors.append({"t": r.get("t", 0), "rank": rank,
                                "site": r.get("name"), "step": r.get("step"),
@@ -584,13 +602,39 @@ def merge_timeline(event_dir: str, heartbeat_dir: str | None = None,
         first_failing = stalled
     else:
         first_failing = first_failure["rank"] if first_failure else None
+    degradations.sort(key=lambda d: d.get("t", 0))
     return {
         "ranks": {str(r): ranks[r] for r in sorted(ranks)},
         "first_failing_rank": first_failing,
         "first_failure": first_failure,
         "first_stalled_rank": stalled,
+        "degradations": degradations[-50:],
         "events": merged[-max_events:],
     }
+
+
+def collect_degradations(event_dir: str) -> list[dict]:
+    """Degradation events (``retry``/``quarantine``/``checkpoint_rollback``/
+    ``checkpoint_quarantine``) from every rank's stream tail — the gang
+    supervisor's SUCCESS path reads these so a run that recovered by
+    rolling back a corrupt checkpoint or retrying a flaky dispatch
+    reports what it survived instead of looking pristine."""
+    out: list[dict] = []
+    try:
+        names = sorted(os.listdir(event_dir))
+    except OSError:
+        return out
+    for fn in names:
+        if not _EVENT_FILE_RE.match(fn):
+            continue
+        try:
+            recs, _ = _read_jsonl_tail(os.path.join(event_dir, fn))
+        except OSError:
+            continue
+        out.extend(r for r in recs
+                   if r.get("name") in _DEGRADATION_EVENTS)
+    out.sort(key=lambda r: r.get("t", 0))
+    return out
 
 
 def format_timeline(tl: dict) -> str:
@@ -616,6 +660,12 @@ def format_timeline(tl: dict) -> str:
             f"gang timeline: only recovered errors on record — rank "
             f"{ff['rank']} at site {ff.get('site') or '?'}"
             + (f" ({ff['error']})" if ff.get("error") else ""))
+    degr = tl.get("degradations") or []
+    if degr:
+        kinds = collections.Counter(d.get("kind") for d in degr)
+        lines.append(
+            "  survived degradations: "
+            + ", ".join(f"{k} x{n}" for k, n in sorted(kinds.items())))
     for r, d in tl.get("ranks", {}).items():
         le = d.get("last_event") or {}
         hb = d.get("heartbeat") or {}

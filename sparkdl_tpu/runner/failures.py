@@ -55,6 +55,58 @@ class TrainingDivergedError(RuntimeError):
         self.value = value
 
 
+class QuarantineOverflowError(RuntimeError):
+    """Fatal: the scorer's dead-letter circuit breaker tripped (ISSUE 4).
+
+    Too large a fraction of rows quarantined — past
+    ``SPARKDL_MAX_QUARANTINE_FRAC`` the input is systematically bad
+    (wrong schema, wrong decoder), not occasionally corrupt, and silently
+    scoring the survivors would hide a data-plane bug. Restarting would
+    re-quarantine the same rows, so retrying burns the budget for nothing.
+    """
+
+    def __init__(self, quarantined: int, seen: int, max_frac: float):
+        super().__init__(
+            f"quarantine circuit breaker: {quarantined}/{seen} rows "
+            f"dead-lettered (> max fraction {max_frac}); the input is "
+            "systematically bad, not occasionally corrupt "
+            "(SPARKDL_MAX_QUARANTINE_FRAC raises the threshold)")
+        self.quarantined = quarantined
+        self.seen = seen
+        self.max_frac = max_frac
+
+
+class ScoringStallError(RuntimeError):
+    """The scoring pipeline's in-flight window made no fetch progress for
+    ``SPARKDL_DISPATCH_TIMEOUT_S`` — a wedged device/interconnect surfaces
+    as a *named, classified* failure (GangFailure-style: which stage, how
+    long) instead of a silent hang only a process-level watchdog could
+    see. DEADLINE_EXCEEDED-shaped, so the retryable/fatal taxonomy routes
+    it to checkpoint-and-restart."""
+
+    def __init__(self, stage: str, timeout_s: float):
+        super().__init__(
+            f"DEADLINE_EXCEEDED: scoring stage '{stage}' made no progress "
+            f"for {timeout_s}s (in-flight window stalled; device or "
+            "interconnect wedged)")
+        self.stage = stage
+        self.timeout_s = timeout_s
+
+
+class ScoringStageError(RuntimeError):
+    """A scoring pipeline stage failed after exhausting its retry budget
+    (or immediately, for fatal errors). Names the stage and attempt count;
+    classification follows the underlying cause, carried as
+    ``__cause__``."""
+
+    def __init__(self, stage: str, attempts: int, cause: BaseException):
+        super().__init__(
+            f"scoring stage '{stage}' failed after {attempts} attempt(s): "
+            f"{type(cause).__name__}: {cause}")
+        self.stage = stage
+        self.attempts = attempts
+
+
 def classify_exception(exc: BaseException) -> str:
     """Return ``"retryable"`` or ``"fatal"`` for a training-run exception.
 
@@ -67,8 +119,14 @@ def classify_exception(exc: BaseException) -> str:
     """
     if isinstance(exc, KeyboardInterrupt):
         return "fatal"
-    if isinstance(exc, TrainingDivergedError):
+    if isinstance(exc, (TrainingDivergedError, QuarantineOverflowError)):
         return "fatal"
+    if isinstance(exc, ScoringStallError):
+        return "retryable"
+    if isinstance(exc, ScoringStageError) and exc.__cause__ is not None:
+        # The stage wrapper is packaging, not policy: the verdict belongs
+        # to the underlying dispatch/fetch error it carries.
+        return classify_exception(exc.__cause__)
     if isinstance(exc, _FATAL_TYPES):
         return "fatal"
     msg = f"{type(exc).__name__}: {exc}"
@@ -102,7 +160,8 @@ def exception_summary(exc: BaseException) -> dict:
 _FATAL_TRACEBACK_NAMES = ("ValueError", "TypeError", "KeyError",
                           "AssertionError", "AttributeError", "IndexError",
                           "ModuleNotFoundError", "ImportError",
-                          "NotImplementedError", "TrainingDivergedError")
+                          "NotImplementedError", "TrainingDivergedError",
+                          "QuarantineOverflowError")
 
 
 def classify_text(text: str) -> str:

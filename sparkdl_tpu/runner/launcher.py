@@ -97,15 +97,27 @@ class GangFailure(RuntimeError):
 @dataclasses.dataclass
 class SuperviseResult:
     """What :func:`supervise` returns: the final (successful) gang's
-    per-rank results plus the recovery ledger."""
+    per-rank results plus the recovery ledger. ``degradations`` (ISSUE 4)
+    lists the faults the final attempt *survived* — checkpoint rollbacks,
+    dispatch retries, quarantined rows — pulled from the ranks' event
+    streams: a run that recovered is a success that must not look
+    pristine."""
     results: list
     restarts: int
     attempts: int
     failure_kinds: list
+    degradations: list = dataclasses.field(default_factory=list)
 
     @property
     def last_failure_kind(self) -> str | None:
         return self.failure_kinds[-1] if self.failure_kinds else None
+
+    @property
+    def rolled_back(self) -> bool:
+        """True when any rank restored from an older checkpoint than the
+        newest on disk (corrupt step quarantined + rollback)."""
+        return any(d.get("name") == "checkpoint_rollback"
+                   for d in self.degradations)
 
 
 def free_port() -> int:
@@ -595,12 +607,26 @@ def supervise(script: str, np: int = 2, args: list[str] | None = None,
             script, np, args, env, timeout_s, None, capture, poll_s,
             heartbeat_dir, watchdog_s, event_dir=event_dir)
         if status == "ok":
+            # Survived-fault ledger BEFORE cleanup: a gang that recovered
+            # by rolling back a corrupt checkpoint / retrying a flaky
+            # dispatch / quarantining rows reports it (ISSUE 4 — a
+            # degradation is recorded, not silently absorbed).
+            try:
+                degradations = events_lib.collect_degradations(event_dir)
+            except Exception:
+                degradations = []
+            if degradations:
+                log.warning(
+                    "supervise: gang succeeded after surviving %d "
+                    "degradation event(s): %s", len(degradations),
+                    sorted({d.get("name") for d in degradations}))
             for d in tmp_dirs:  # kept on failure paths for postmortems
                 shutil.rmtree(d, ignore_errors=True)
             _prune_empty_gang_dir(adopted_dir)
             return SuperviseResult(results=results, restarts=restarts,
                                    attempts=restarts + 1,
-                                   failure_kinds=kinds)
+                                   failure_kinds=kinds,
+                                   degradations=degradations)
         err = _failure(status, results, info, timeout_s, capture,
                        event_dir=event_dir, heartbeat_dir=heartbeat_dir)
         kinds.append(err.kind)

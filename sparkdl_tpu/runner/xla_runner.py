@@ -174,6 +174,20 @@ class RunnerContext:
             self._ckpt = CheckpointManager(self.checkpoint_dir)
         return self._ckpt
 
+    def _close_checkpoints(self):
+        """Error-path cleanup (ISSUE 4 satellite): close the manager
+        exactly once — ``CheckpointManager.close`` is idempotent and
+        finalizes any in-flight async save + its manifest; dropping the
+        cached instance lets the property re-open for a retry on the
+        same context."""
+        ckpt, self._ckpt = self._ckpt, None
+        if ckpt is not None:
+            try:
+                ckpt.close()
+            except Exception:
+                log.warning("checkpoint close on error path failed",
+                            exc_info=True)
+
     def trace(self, log_dir: str | None = None):
         # metrics.trace emits the flight-recorder event carrying the trace
         # dir, so a postmortem's event tail links to the profile on disk.
@@ -445,8 +459,13 @@ class RunnerContext:
             # is unwinding on a failure: the whole point of dying mid-run
             # is resuming from the last save, which must not be left
             # half-committed (latest_step would skip it and the restart
-            # would silently redo checkpoint_every extra steps).
-            if self._ckpt is not None:
+            # would silently redo checkpoint_every extra steps). On the
+            # error path the manager is then CLOSED (exactly once —
+            # close() is idempotent and subsumes the wait): the resumed
+            # attempt opens its own.
+            if failed:
+                self._close_checkpoints()
+            elif self._ckpt is not None:
                 try:
                     self._ckpt.wait()
                 except Exception:
@@ -465,6 +484,7 @@ class RunnerContext:
         except BaseException as e:
             events.postmortem(e, site="fit_finalize", step=i)
             e._sparkdl_postmortemed = True
+            self._close_checkpoints()
             raise
         # Final telemetry: percentiles + MFU land in the logger (TB/text)
         # and the fit_end event, next to the per-step series.

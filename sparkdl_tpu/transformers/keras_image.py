@@ -14,8 +14,9 @@ import numpy as np
 import pyarrow as pa
 
 from ..core.frame import DataFrame
-from ..core.params import (HasBatchSize, HasInputCol, HasOutputCol, Param,
-                           Params, TypeConverters, keyword_only)
+from ..core.params import (HasBatchSize, HasInputCol, HasOnError,
+                           HasOutputCol, Param, Params, TypeConverters,
+                           keyword_only)
 from ..core.pipeline import Transformer
 from ..core.runtime import BatchRunner
 from .keras_utils import keras_file_to_fn
@@ -56,11 +57,13 @@ def loadImageBatch(loader, uris, workers: int = 0) -> np.ndarray:
 
 class KerasImageFileTransformer(BundlesModelFile, PicklesCallableParams,
                                 Transformer, HasInputCol, HasOutputCol,
-                                HasBatchSize):
+                                HasBatchSize, HasOnError):
     """Loads images from a URI column via ``imageLoader`` and applies a saved
     Keras model (``modelFile``, Keras-3-on-JAX) as one jitted XLA program.
     save() bundles the model file with the stage (BundlesModelFile), so
-    fitted transformers persist durably."""
+    fitted transformers persist durably. ``onError='quarantine'``
+    dead-letters rows whose URI fails to load/decode (missing file,
+    truncated image) instead of killing the scoring job."""
 
     modelFile = Param(Params, "modelFile", "path to a saved Keras model "
                       "(.keras/.h5)", TypeConverters.toString)
@@ -71,14 +74,14 @@ class KerasImageFileTransformer(BundlesModelFile, PicklesCallableParams,
 
     @keyword_only
     def __init__(self, inputCol=None, outputCol=None, modelFile=None,
-                 imageLoader=None, batchSize=None):
+                 imageLoader=None, batchSize=None, onError=None):
         super().__init__()
-        self._setDefault(batchSize=32)
+        self._setDefault(batchSize=32, onError="raise")
         self._set(**self._input_kwargs)
 
     @keyword_only
     def setParams(self, inputCol=None, outputCol=None, modelFile=None,
-                  imageLoader=None, batchSize=None):
+                  imageLoader=None, batchSize=None, onError=None):
         return self._set(**self._input_kwargs)
 
     def _make_fn(self):
@@ -100,22 +103,30 @@ class KerasImageFileTransformer(BundlesModelFile, PicklesCallableParams,
         loader = self.getOrDefault(self.imageLoader)
         runner = self._get_runner()
 
-        def chunk_thunks(batch: pa.RecordBatch) -> list:
+        def make_decoder(batch: pa.RecordBatch):
             uris = batch.column(in_col).to_pylist()
-            # Load lazily per device chunk: each thunk fans its URI batch
+
+            # Load lazily per device chunk: each decode fans its URI batch
             # over the shared decode executor (loadImageBatch) AND the
-            # thunks themselves pipeline on the scorer's decode pool —
+            # chunks themselves pipeline on the scorer's decode pool —
             # chunk k+1 loads while the TPU computes chunk k, across
             # partition boundaries. Peak host memory is one chunk x the
-            # in-flight window, not the whole partition.
-            return [
-                lambda i=i: loadImageBatch(loader, uris[i:i + batch_size])
-                for i in range(0, len(uris), batch_size)]
+            # in-flight window, not the whole partition. The quarantine
+            # fallback calls the same decoder per row (length=1), so a bad
+            # URI dead-letters just its own row.
+            def decode(start: int, length: int) -> np.ndarray:
+                return loadImageBatch(loader, uris[start:start + length])
+
+            return decode
 
         from .streaming import StreamScorer
         from .xla_image import emptyVectorColumn
-        return dataset.mapStream(StreamScorer(
-            runner, out_col, chunk_thunks, arrayColumnToArrow,
-            emptyVectorColumn))
+        on_error = self.getOnError()
+        scorer = StreamScorer(runner, out_col, make_decoder,
+                              arrayColumnToArrow, emptyVectorColumn,
+                              chunk_rows=batch_size, on_error=on_error)
+        self._quarantine_sink = scorer.sink
+        return dataset.mapStream(scorer,
+                                 changes_length=on_error == "quarantine")
 
     _pickled_params = ("imageLoader",)

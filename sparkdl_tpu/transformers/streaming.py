@@ -26,6 +26,18 @@ This module is the shared replacement (ISSUE 3 tentpole): one
   the int32→large_list offset promotion handled once in
   :func:`concatChunkArrays`.
 
+Fault isolation (ISSUE 4 tentpole): with ``on_error='quarantine'`` a
+host-side decode/payload failure no longer kills the whole job — the
+failing chunk is re-decoded row by row, bad rows route to a **dead-letter
+side output** (:class:`QuarantineSink`: the original row +
+``error_class``/``error`` columns, Spark's task-isolation semantics at row
+granularity) and the surviving rows continue through the device stream.
+A circuit breaker (``SPARKDL_MAX_QUARANTINE_FRAC``, default 0.5) fails
+the job with a fatal :class:`QuarantineOverflowError` when the bad-row
+fraction says the *input* is broken, not the odd record. Device-side
+dispatch/fetch faults are retried with backoff inside
+``BatchRunner.run_stream`` (see ``core/runtime.py``).
+
 Peak host memory stays O(window · batchSize) decoded rows + the pending
 partitions whose chunks are in flight — the same O(batchSize) contract the
 per-partition design had, now without the per-boundary stalls.
@@ -34,13 +46,42 @@ per-partition design had, now without the per-boundary stalls.
 from __future__ import annotations
 
 import collections
+import os
 from typing import Callable, Iterator
 
 import numpy as np
 import pyarrow as pa
 
 from ..core.frame import _set_column
-from ..core.runtime import BatchRunner, parallel_map_iter
+from ..core.runtime import (BatchRunner, _chaos, _events, _failures,
+                            _run_stats, parallel_map_iter)
+
+ERROR_CLASS_COL = "error_class"
+ERROR_COL = "error"
+
+
+def max_quarantine_frac_default() -> float:
+    """Dead-letter circuit-breaker threshold: the job fails (fatal) once
+    quarantined_rows / seen_rows exceeds this fraction
+    (``SPARKDL_MAX_QUARANTINE_FRAC``, default 0.5 — half the input bad
+    means the pipeline, not the data, is broken)."""
+    try:
+        return float(os.environ.get("SPARKDL_MAX_QUARANTINE_FRAC", "0.5"))
+    except ValueError:
+        return 0.5
+
+
+def quarantine_min_rows_default() -> int:
+    """Minimum rows seen before the circuit breaker may trip MID-stream
+    (``SPARKDL_QUARANTINE_MIN_ROWS``, default 100): one corrupt leading
+    chunk must not read as "half the input is bad" and fatally kill a
+    job whose overall bad fraction is tiny. At end of stream the breaker
+    evaluates the TRUE whole-input fraction with no floor."""
+    try:
+        return max(1, int(
+            os.environ.get("SPARKDL_QUARANTINE_MIN_ROWS", "100")))
+    except ValueError:
+        return 100
 
 
 def concatChunkArrays(pieces: list[pa.Array]) -> pa.Array:
@@ -59,71 +100,257 @@ def concatChunkArrays(pieces: list[pa.Array]) -> pa.Array:
     return pa.concat_arrays(pieces)
 
 
+class QuarantineSink:
+    """Collects dead-letter rows: each quarantined input row rides with an
+    ``error_class`` (exception type name) and ``error`` (message) column.
+
+    Schema is pinned from the FIRST input partition (``ensure_schema``),
+    so :meth:`to_table` returns a stably-typed table even when nothing was
+    quarantined — the empty-quarantine and all-rows-quarantined edges
+    round-trip through Arrow identically. Consumer-thread only (the
+    scorer's reassembly loop); not thread-safe by design."""
+
+    def __init__(self):
+        self.batches: list[pa.RecordBatch] = []
+        self.rows = 0
+        self._schema: pa.Schema | None = None
+
+    def ensure_schema(self, input_schema: pa.Schema):
+        if self._schema is None:
+            self._schema = pa.schema(
+                list(input_schema)
+                + [pa.field(ERROR_CLASS_COL, pa.string()),
+                   pa.field(ERROR_COL, pa.string())])
+
+    @property
+    def schema(self) -> pa.Schema | None:
+        return self._schema
+
+    def add(self, batch: pa.RecordBatch, dead: list[tuple]):
+        """``dead``: ``[(row_index, error_class, message), ...]`` into
+        ``batch`` — appended as one dead-letter RecordBatch."""
+        if not dead:
+            return
+        self.ensure_schema(batch.schema)
+        src = batch.take(pa.array([r for r, _, _ in dead], type=pa.int64()))
+        arrays = list(src.columns) + [
+            pa.array([c for _, c, _ in dead], type=pa.string()),
+            pa.array([m[:500] for _, _, m in dead], type=pa.string())]
+        self.batches.append(pa.RecordBatch.from_arrays(
+            arrays, schema=self._schema))
+        self.rows += len(dead)
+
+    def publish_to(self, dest: "QuarantineSink"):
+        """Hand this run's collection to the transformer-visible sink.
+        The schema pin always transfers; the dead-letter rows replace
+        ``dest``'s only when this run actually quarantined something —
+        so a 1-row schema probe (``DataFrame.schema`` re-invokes the
+        stream op) or an early-closed ``take()`` pass cannot silently
+        wipe the ledger of the last real materialization."""
+        if dest._schema is None:
+            dest._schema = self._schema
+        if self.rows:
+            dest.batches = self.batches
+            dest.rows = self.rows
+            dest._schema = self._schema
+
+    def to_table(self) -> pa.Table:
+        if self.batches:
+            return pa.Table.from_batches(self.batches)
+        if self._schema is not None:
+            return self._schema.empty_table()
+        return pa.table({})
+
+
 class StreamScorer:
     """``DataFrame.mapStream`` op scoring a column through a BatchRunner.
 
     Per-transformer behavior plugs in via three callables:
 
-    - ``chunk_thunks(batch) -> list[() -> host_array]``: split one
-      partition into device-batch decode thunks (each runs on the decode
-      pool and returns the host array for one ``BatchRunner`` batch);
+    - ``make_decoder(batch) -> decode(start, length) -> host_array``:
+      per-partition setup (pin the target shape, resolve the feed dtype)
+      returning a slice decoder — the scorer chunks the partition into
+      ``chunk_rows``-row device batches itself and calls ``decode`` per
+      chunk on the decode pool (and per ROW on the quarantine fallback
+      path);
     - ``encode(np.ndarray) -> pa.Array``: device output chunk → its final
       Arrow representation (runs on the overlap worker);
     - ``empty_array() -> pa.Array``: output column for a zero-row
       partition.
+
+    ``on_error='quarantine'`` arms row-level fault isolation: a chunk
+    whose decode raises is retried row by row; rows that still fail (or
+    decode to a deviant shape) are dead-lettered into ``sink`` and the
+    scored output batch simply omits them (length-changing — pair with
+    ``mapStream(..., changes_length=True)``). ``max_quarantine_frac``
+    bounds the damage (default: :func:`max_quarantine_frac_default`).
     """
 
     def __init__(self, runner: BatchRunner, out_col: str,
-                 chunk_thunks: Callable, encode: Callable,
-                 empty_array: Callable, decode_workers: int | None = None):
+                 make_decoder: Callable, encode: Callable,
+                 empty_array: Callable, chunk_rows: int | None = None,
+                 decode_workers: int | None = None,
+                 on_error: str = "raise",
+                 max_quarantine_frac: float | None = None,
+                 sink: QuarantineSink | None = None):
+        if on_error not in ("raise", "quarantine"):
+            raise ValueError(f"on_error must be 'raise' or 'quarantine', "
+                             f"got {on_error!r}")
         self.runner = runner
         self.out_col = out_col
-        self.chunk_thunks = chunk_thunks
+        self.make_decoder = make_decoder
         self.encode = encode
         self.empty_array = empty_array
+        self.chunk_rows = int(chunk_rows or runner.batch_size)
         self.decode_workers = decode_workers
+        self.on_error = on_error
+        self.max_quarantine_frac = (
+            max_quarantine_frac if max_quarantine_frac is not None
+            else max_quarantine_frac_default())
+        self.sink = sink if sink is not None else (
+            QuarantineSink() if on_error == "quarantine" else None)
 
     # -- stages ------------------------------------------------------------
     def _decode(self, item):
-        thunk, entry = item
-        from ..core.runtime import _events
-        with _events().span("decode"):
-            return thunk(), entry
+        """Decode one chunk (pool thread). Returns ``(array_or_None,
+        entry, info)`` — ``info`` is None in raise mode; in quarantine
+        mode it carries the chunk length and the dead rows so ALL sink /
+        counter mutation happens later on the consumer thread."""
+        decoder, start, length, entry = item
+        with _events().span("decode", rows=length):
+            if self.on_error != "quarantine":
+                _chaos().fire("decode")
+                return decoder(start, length), entry, None
+            try:
+                _chaos().fire("decode")
+                return decoder(start, length), entry, \
+                    {"length": length, "dead": []}
+            except Exception:  # noqa: BLE001 — row fallback re-derives
+                return self._decode_rows(decoder, start, length, entry)
+
+    def _decode_rows(self, decoder, start, length, entry):
+        """Row-level quarantine fallback: re-decode the failed chunk one
+        row at a time; rows that still raise — or decode clean but with a
+        deviant trailing shape that would crash the batch concat or
+        recompile the program — are dead-lettered instead of killing the
+        stream."""
+        arrs, rows, dead = [], [], []
+        for j in range(start, start + length):
+            try:
+                _chaos().fire("decode")
+                arrs.append(decoder(j, 1))
+                rows.append(j)
+            except Exception as e:  # noqa: BLE001 — becomes the dead letter
+                dead.append((j, type(e).__name__, str(e)))
+        if arrs:
+            modal = collections.Counter(
+                a.shape[1:] for a in arrs).most_common(1)[0][0]
+            kept = [(a, r) for a, r in zip(arrs, rows)
+                    if a.shape[1:] == modal]
+            dead.extend((r, "ShapeMismatch",
+                         f"row decodes to shape {a.shape[1:]}, chunk "
+                         f"decodes to {modal}")
+                        for a, r in zip(arrs, rows) if a.shape[1:] != modal)
+            arrs = [a for a, _ in kept]
+        dead.sort()
+        arr = np.concatenate(arrs, axis=0) if arrs else None
+        return arr, entry, {"length": length, "dead": dead}
 
     def _encode(self, result: np.ndarray) -> pa.Array:
-        from ..core.runtime import _events
         with _events().span("encode", rows=len(result)):
             return self.encode(result)
 
-    def _finish(self, entry: dict) -> pa.RecordBatch:
+    def _finish(self, entry: dict, sink: QuarantineSink | None
+                ) -> pa.RecordBatch:
         batch = entry["batch"]
-        if not entry["n_chunks"]:
-            return _set_column(batch, self.out_col, self.empty_array())
+        dead = entry["dead"]
+        scored = batch
+        if dead:
+            if sink is not None:
+                sink.add(batch, dead)
+            dead_rows = {r for r, _, _ in dead}
+            keep = [i for i in range(batch.num_rows) if i not in dead_rows]
+            scored = (batch.take(pa.array(keep, type=pa.int64())) if keep
+                      else batch.slice(0, 0))
         pieces = [f.result() for f in entry["futs"]]
-        return _set_column(batch, self.out_col, concatChunkArrays(pieces))
+        if not pieces:
+            return _set_column(scored, self.out_col, self.empty_array())
+        return _set_column(scored, self.out_col, concatChunkArrays(pieces))
 
     # -- the stream op -----------------------------------------------------
     def __call__(self, parts: Iterator[pa.RecordBatch]
                  ) -> Iterator[pa.RecordBatch]:
         from concurrent.futures import ThreadPoolExecutor
+        ev = _events()
         # Entries appear here in partition order as the chunk producer
         # (pulled on this thread through the decode pool / put window)
         # walks the input; each holds its RecordBatch and expected chunk
         # count host-side — the row-count bookkeeping the continuous
         # device stream does not carry.
         pending: collections.deque[dict] = collections.deque()
+        totals = {"seen": 0, "quarantined": 0}
+        # Each invocation (one materialization of the lazy result)
+        # collects into its OWN sink, published to the transformer-
+        # visible one only at completion — see QuarantineSink.publish_to.
+        run_sink = QuarantineSink() if self.sink is not None else None
+        min_rows = quarantine_min_rows_default()
+
+        def breaker_check(floor: int):
+            if totals["seen"] >= floor and totals["quarantined"] > \
+                    self.max_quarantine_frac * totals["seen"]:
+                raise _failures().QuarantineOverflowError(
+                    totals["quarantined"], totals["seen"],
+                    self.max_quarantine_frac)
 
         def chunk_stream():
             for rb in parts:
-                thunks = self.chunk_thunks(rb) if rb.num_rows else []
-                entry = {"batch": rb, "n_chunks": len(thunks), "futs": []}
+                if run_sink is not None and rb.num_rows == 0 \
+                        and run_sink.schema is None:
+                    run_sink.ensure_schema(rb.schema)
+                decoder = self.make_decoder(rb) if rb.num_rows else None
+                starts = range(0, rb.num_rows, self.chunk_rows)
+                entry = {"batch": rb, "n_chunks": len(starts), "futs": [],
+                         "n_skipped": 0, "dead": []}
                 pending.append(entry)
-                for t in thunks:
-                    yield t, entry
+                for s in starts:
+                    yield (decoder, s,
+                           min(self.chunk_rows, rb.num_rows - s), entry)
+
+        def complete(entry: dict) -> bool:
+            return len(entry["futs"]) + entry["n_skipped"] \
+                == entry["n_chunks"]
 
         decoded = parallel_map_iter(
             self._decode, chunk_stream(), workers=self.decode_workers,
             maxsize=max(self.runner.prefetch, 1))
+
+        def device_stream():
+            """Consumer-thread filter between the decode pool and the
+            device window: records quarantine bookkeeping (sink schema,
+            entry dead rows, counters, the circuit breaker) and drops
+            chunks with no surviving rows."""
+            for arr, entry, info in decoded:
+                if info is not None:
+                    totals["seen"] += info["length"]
+                    if run_sink is not None and run_sink.schema is None:
+                        run_sink.ensure_schema(entry["batch"].schema)
+                    dead = info["dead"]
+                    if dead:
+                        entry["dead"].extend(dead)
+                        totals["quarantined"] += len(dead)
+                        _run_stats().record_quarantine(len(dead))
+                        ev.event("quarantine", rows=len(dead),
+                                 error_class=dead[0][1],
+                                 total=totals["quarantined"])
+                        # Mid-stream the breaker needs a sample-size
+                        # floor — one corrupt leading chunk is not "half
+                        # the input is bad".
+                        breaker_check(min_rows)
+                if arr is None or not len(arr):
+                    entry["n_skipped"] += 1
+                    continue
+                yield arr, entry
+
         encode_pool = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="sparkdl-encode")
         # Backpressure for the overlap worker: un-encoded RAW outputs are
@@ -136,7 +363,7 @@ class StreamScorer:
         backlog: collections.deque = collections.deque()
         max_backlog = max(2, int(getattr(self.runner, "prefetch", 2)))
         try:
-            for out, entry in self.runner.run_stream(decoded):
+            for out, entry in self.runner.run_stream(device_stream()):
                 # Hand the Arrow encode to the overlap worker and go
                 # straight back to the device stream — the feed waits on
                 # encoding only past the bounded backlog.
@@ -147,10 +374,14 @@ class StreamScorer:
                 fut = encode_pool.submit(self._encode, np.asarray(out))
                 backlog.append(fut)
                 entry["futs"].append(fut)
-                while pending and \
-                        len(pending[0]["futs"]) == pending[0]["n_chunks"]:
-                    yield self._finish(pending.popleft())
+                while pending and complete(pending[0]):
+                    yield self._finish(pending.popleft(), run_sink)
+            # End of stream: the breaker now knows the TRUE whole-input
+            # bad fraction — evaluate it with no sample-size floor.
+            breaker_check(1)
             while pending:
-                yield self._finish(pending.popleft())
+                yield self._finish(pending.popleft(), run_sink)
+            if run_sink is not None:
+                run_sink.publish_to(self.sink)
         finally:
             encode_pool.shutdown(wait=False, cancel_futures=True)

@@ -13,8 +13,9 @@ import numpy as np
 import pyarrow as pa
 
 from ..core.frame import DataFrame
-from ..core.params import (HasBatchSize, HasInputCol, HasOutputCol, Param,
-                           Params, TypeConverters, keyword_only)
+from ..core.params import (HasBatchSize, HasInputCol, HasOnError,
+                           HasOutputCol, Param, Params, TypeConverters,
+                           keyword_only)
 from ..core.pipeline import Transformer
 from ..core.runtime import BatchRunner
 from .keras_utils import keras_file_to_fn
@@ -50,9 +51,11 @@ def columnToNdarray(column: pa.Array, shape: tuple | None,
 
 
 class XlaTransformer(PicklesCallableParams, Transformer, HasInputCol,
-                     HasOutputCol, HasBatchSize):
+                     HasOutputCol, HasBatchSize, HasOnError):
     """Applies a jittable ``fn(batch)`` to a numeric array column (the
-    TFTransformer analogue)."""
+    TFTransformer analogue). ``onError='quarantine'`` dead-letters rows
+    whose payload fails to decode (ragged/mis-shaped arrays) instead of
+    killing the job."""
 
     fn = Param(Params, "fn", "jittable function over (N, ...) float batches",
                TypeConverters.toCallable)
@@ -63,14 +66,14 @@ class XlaTransformer(PicklesCallableParams, Transformer, HasInputCol,
 
     @keyword_only
     def __init__(self, inputCol=None, outputCol=None, fn=None,
-                 inputShape=None, batchSize=None):
+                 inputShape=None, batchSize=None, onError=None):
         super().__init__()
-        self._setDefault(batchSize=64)
+        self._setDefault(batchSize=64, onError="raise")
         self._set(**self._input_kwargs)
 
     @keyword_only
     def setParams(self, inputCol=None, outputCol=None, fn=None,
-                  inputShape=None, batchSize=None):
+                  inputShape=None, batchSize=None, onError=None):
         return self._set(**self._input_kwargs)
 
     def _make_fn(self):
@@ -98,19 +101,26 @@ class XlaTransformer(PicklesCallableParams, Transformer, HasInputCol,
                  if self.isDefined(self.inputShape) else None)
         runner = self._get_runner()
 
-        def chunk_thunks(batch: pa.RecordBatch) -> list:
+        def make_decoder(batch: pa.RecordBatch):
             # Decode per device chunk on the pool (zero-copy Arrow→ndarray
             # per slice) — peak host memory O(batchSize), and the chunks
             # of every partition ride ONE device stream (no window drain
-            # at partition boundaries).
+            # at partition boundaries). The same decoder serves the
+            # quarantine fallback at row granularity.
             col = batch.column(in_col)
-            return [
-                lambda i=i: columnToNdarray(col.slice(i, batch_size), shape)
-                for i in range(0, batch.num_rows, batch_size)]
 
-        return dataset.mapStream(StreamScorer(
-            runner, out_col, chunk_thunks, arrayColumnToArrow,
-            emptyVectorColumn))
+            def decode(start: int, length: int) -> np.ndarray:
+                return columnToNdarray(col.slice(start, length), shape)
+
+            return decode
+
+        on_error = self.getOnError()
+        scorer = StreamScorer(runner, out_col, make_decoder,
+                              arrayColumnToArrow, emptyVectorColumn,
+                              chunk_rows=batch_size, on_error=on_error)
+        self._quarantine_sink = scorer.sink
+        return dataset.mapStream(scorer,
+                                 changes_length=on_error == "quarantine")
 
     _pickled_params = ("fn",)
 

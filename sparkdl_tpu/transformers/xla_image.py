@@ -21,8 +21,9 @@ import numpy as np
 import pyarrow as pa
 
 from ..core.frame import DataFrame
-from ..core.params import (HasBatchSize, HasInputCol, HasOutputCol, Param,
-                           Params, TypeConverters, keyword_only)
+from ..core.params import (HasBatchSize, HasInputCol, HasOnError,
+                           HasOutputCol, Param, Params, TypeConverters,
+                           keyword_only)
 from ..core.pipeline import Transformer
 from ..core.runtime import BatchRunner
 from ..image import imageIO
@@ -53,11 +54,14 @@ def emptyVectorColumn() -> pa.Array:
 
 
 class XlaImageTransformer(PicklesCallableParams, Transformer, HasInputCol,
-                          HasOutputCol, HasBatchSize):
+                          HasOutputCol, HasBatchSize, HasOnError):
     """Applies ``fn`` (jittable, NHWC float32 in, array out) to an image column.
 
     ``inputSize=(H, W)`` resizes every image to a static shape (XLA needs
     static shapes; mixed-size columns are resized on the host feed path).
+    ``onError='quarantine'`` dead-letters rows whose image payload fails
+    to decode instead of killing the job (see README "Scoring failure
+    semantics"; read them back via :meth:`deadLetters`).
     """
 
     fn = Param(Params, "fn", "jittable function applied to NHWC batches",
@@ -80,16 +84,16 @@ class XlaImageTransformer(PicklesCallableParams, Transformer, HasInputCol,
     @keyword_only
     def __init__(self, inputCol=None, outputCol=None, fn=None, inputSize=None,
                  batchSize=None, channelOrder=None, outputMode=None,
-                 numDevices=None):
+                 numDevices=None, onError=None):
         super().__init__()
         self._setDefault(batchSize=32, channelOrder="RGB", outputMode="vector",
-                         inputCol="image", numDevices=1)
+                         inputCol="image", numDevices=1, onError="raise")
         self._set(**self._input_kwargs)
 
     @keyword_only
     def setParams(self, inputCol=None, outputCol=None, fn=None, inputSize=None,
                   batchSize=None, channelOrder=None, outputMode=None,
-                  numDevices=None):
+                  numDevices=None, onError=None):
         return self._set(**self._input_kwargs)
 
     def _make_fn(self):
@@ -147,12 +151,13 @@ class XlaImageTransformer(PicklesCallableParams, Transformer, HasInputCol,
         batch_size = self.getBatchSize()
         runner = self._get_runner()
 
-        def chunk_thunks(batch: pa.RecordBatch) -> list:
+        def make_decoder(batch: pa.RecordBatch):
             # One Arrow partition may exceed the device batch: decode AND
             # run per device-chunk, so peak host memory is O(batchSize)
             # decoded pixels, not O(partition) (round-1 verdict weak #4).
-            # Each thunk runs on the parallel decode pool
-            # (SPARKDL_DECODE_WORKERS) while earlier chunks execute.
+            # Each chunk decode runs on the parallel decode pool
+            # (SPARKDL_DECODE_WORKERS) while earlier chunks execute; the
+            # quarantine fallback calls the same decoder per row.
             col = batch.column(in_col)
             h, w = size
             if h is None or w is None:
@@ -169,11 +174,13 @@ class XlaImageTransformer(PicklesCallableParams, Transformer, HasInputCol,
             feed_dtype = (np.uint8 if all(
                 imageIO.ocvTypeByMode(int(m)).dtype == "uint8"
                 for m in np.unique(modes)) else np.float32)
-            return [
-                lambda i=i: imageIO.imageColumnToNHWC(
-                    col.slice(i, batch_size), h, w, channelOrder=order,
+
+            def decode(start: int, length: int) -> np.ndarray:
+                return imageIO.imageColumnToNHWC(
+                    col.slice(start, length), h, w, channelOrder=order,
                     dtype=feed_dtype)
-                for i in range(0, batch.num_rows, batch_size)]
+
+            return decode
 
         # Each device chunk converts to its FINAL Arrow representation on
         # the scorer's overlap worker as it lands — the float32 model
@@ -192,7 +199,14 @@ class XlaImageTransformer(PicklesCallableParams, Transformer, HasInputCol,
             encode = arrayColumnToArrow
             empty_array = emptyVectorColumn
 
-        return dataset.mapStream(StreamScorer(
-            runner, out_col, chunk_thunks, encode, empty_array))
+        on_error = self.getOnError()
+        scorer = StreamScorer(runner, out_col, make_decoder, encode,
+                              empty_array, chunk_rows=batch_size,
+                              on_error=on_error)
+        # Dead letters of the most recent materialized transform, read
+        # back through HasOnError.deadLetters() after collect().
+        self._quarantine_sink = scorer.sink
+        return dataset.mapStream(scorer,
+                                 changes_length=on_error == "quarantine")
 
     _pickled_params = ("fn",)

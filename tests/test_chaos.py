@@ -273,3 +273,108 @@ def test_obs_smoke_script(tmp_path):
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert '"ok": true' in proc.stdout, proc.stdout[-2000:]
+
+
+class TestCorruptKind:
+    """ISSUE 4: the `corrupt` fault kind + the new decode/dispatch/
+    checkpoint_restore sites (their behavioral coverage lives in
+    test_runtime.py / test_streaming.py / test_checkpoint.py)."""
+
+    def test_new_sites_and_kind_validate(self):
+        # corrupt is checkpoint_restore-only
+        with pytest.raises(ValueError, match="corrupt"):
+            Fault("step_start", "corrupt", prob=1.0)
+        for site in ("decode", "dispatch", "checkpoint_restore"):
+            assert Fault(site, "preempt", prob=1.0).site == site
+        f = Fault("checkpoint_restore", "corrupt", prob=1.0)
+        # env transport round-trips the new site/kind
+        back = FaultPlan.from_env(FaultPlan([f]).to_env())
+        assert back.faults == [f]
+
+    def test_corrupt_damages_newest_step_only(self, tmp_path):
+        for step, size in ((1, 64), (2, 64)):
+            d = tmp_path / str(step)
+            d.mkdir()
+            (d / "data.bin").write_bytes(b"\x00" * size)
+        damaged = chaos.corrupt_latest_checkpoint(str(tmp_path))
+        assert damaged and "/2/" in damaged[0]
+        assert (tmp_path / "2" / "data.bin").stat().st_size < 64  # truncated
+        assert (tmp_path / "1" / "data.bin").stat().st_size == 64  # untouched
+        # robust no-ops: empty dir / missing dir / None
+        assert chaos.corrupt_latest_checkpoint(str(tmp_path / "none")) == []
+        assert chaos.corrupt_latest_checkpoint(None) == []
+
+    def test_corrupt_fires_through_restore_site(self, tmp_path):
+        """fire('checkpoint_restore', path=...) with a corrupt fault
+        damages the newest step under path and records the injection."""
+        d = tmp_path / "3"
+        d.mkdir()
+        (d / "leaf.bin").write_bytes(b"\x11" * 32)
+        chaos.install(FaultPlan([Fault("checkpoint_restore", "corrupt",
+                                       prob=1.0)]))
+        chaos.fire("checkpoint_restore", path=str(tmp_path))
+        assert (d / "leaf.bin").stat().st_size < 32
+        assert run_stats.fault_sites == ["checkpoint_restore:corrupt"]
+
+
+@pytest.mark.slow
+def test_supervised_gang_rolls_back_corrupt_checkpoint(tmp_path):
+    """ISSUE 4 acceptance, gang level: attempt 1 checkpoints steps 2 and 4
+    then dies on an injected preemption; before attempt 2's restore an
+    injected `corrupt` fault damages step 4 on disk. The restore must
+    quarantine it, roll back to verified step 2, and finish within the
+    restart budget — with the rollback visible on the SuperviseResult's
+    degradation ledger (no death loop)."""
+    from sparkdl_tpu.runner.launcher import supervise
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(f"""
+import json, os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, {_REPO!r})
+import numpy as np
+import optax
+from sparkdl_tpu.runner import XlaRunner, softmax_cross_entropy_loss
+
+out_dir = sys.argv[1]
+runner = XlaRunner(checkpoint_dir=os.path.join(out_dir, "ckpt"))
+rng = np.random.RandomState(0)
+params = {{"w": rng.randn(4, 3).astype(np.float32)}}
+
+def data():
+    r = np.random.RandomState(1)
+    while True:
+        yield {{"image": r.randn(8, 4).astype(np.float32),
+               "label": r.randint(0, 3, (8,))}}
+
+res = runner.run(lambda ctx: ctx.fit(
+    loss_fn=softmax_cross_entropy_loss(), params=params, tx=optax.sgd(0.1),
+    apply_fn=lambda p, x: x @ p["w"], data=data(), num_steps=6,
+    checkpoint_every=2, log_every=100))
+with open(os.path.join(out_dir, "attempts.jsonl"), "a") as f:
+    f.write(json.dumps({{"final_step": int(res["state"].step),
+                        "steps_this_attempt": res["meter"].steps}}) + "\\n")
+""")
+    plan = FaultPlan([
+        Fault("step_start", "preempt", at_step=5),
+        Fault("checkpoint_restore", "corrupt", prob=1.0),
+    ])
+    res = supervise(str(worker), np=1, args=[str(tmp_path)],
+                    timeout_s=300.0, max_restarts=2, backoff_s=0.1,
+                    poll_s=0.25, plan=plan)
+    attempts = [json.loads(ln)
+                for ln in open(tmp_path / "attempts.jsonl")]
+    assert res.restarts == 1  # one relaunch, within budget — no death loop
+    assert res.failure_kinds == ["retryable"]
+    # rolled back to step 2 (not 4): the resumed attempt ran 4 steps
+    assert attempts == [{"final_step": 6, "steps_this_attempt": 4}]
+    assert res.rolled_back
+    kinds = {d.get("name") for d in res.degradations}
+    assert "checkpoint_rollback" in kinds
+    assert "checkpoint_quarantine" in kinds
+    rb = [d for d in res.degradations
+          if d.get("name") == "checkpoint_rollback"][0]
+    assert (rb["from_step"], rb["to_step"]) == (4, 2)
+    # forensics: the corrupt step dir is quarantined on disk
+    import glob as glob_mod
+    assert glob_mod.glob(str(tmp_path / "ckpt" / "4.corrupt*"))

@@ -1,0 +1,259 @@
+"""Verified checkpoints (ISSUE 4): manifests, corruption quarantine,
+rollback-to-verified-step, idempotent wait/close, load_portable reporting.
+
+All CPU-only with tiny synthetic states — the restore-fallback acceptance
+runs in-process (the gang-level variant lives in test_chaos.py, slow)."""
+
+import glob
+import os
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+from sparkdl_tpu.runner import (CheckpointManager, TrainState, XlaRunner,
+                                softmax_cross_entropy_loss)
+from sparkdl_tpu.runner import chaos, events, metrics
+from sparkdl_tpu.runner.chaos import corrupt_latest_checkpoint
+from sparkdl_tpu.runner.checkpoint import (CheckpointCorruptionError,
+                                           load_portable, save_portable)
+
+
+def _state(value: float):
+    return TrainState.create(
+        None, {"w": np.full((4, 3), value, np.float32)}, optax.sgd(0.1))
+
+
+def _two_step_dir(tmp_path):
+    d = str(tmp_path / "ckpt")
+    m = CheckpointManager(d, async_save=False)
+    m.save(1, _state(1.0), wait=True)
+    m.save(2, _state(2.0), wait=True)
+    return d, m
+
+
+class TestVerifiedCheckpoints:
+    def test_manifest_committed_per_step(self, tmp_path):
+        d, m = _two_step_dir(tmp_path)
+        names = sorted(os.path.basename(p)
+                       for p in glob.glob(d + "/manifest_step_*.json"))
+        assert names == ["manifest_step_1.json", "manifest_step_2.json"]
+        assert m.verify_step(1) == (True, "ok")
+        assert m.verify_step(2) == (True, "ok")
+        m.close()
+
+    def test_restore_falls_back_to_verified_step(self, tmp_path):
+        """THE restore-fallback satellite: corrupt the latest step on
+        disk; restore must quarantine it (dir renamed *.corrupt) and land
+        on the previous verified step, recording the rollback."""
+        metrics.run_stats.reset()
+        d, m = _two_step_dir(tmp_path)
+        assert corrupt_latest_checkpoint(d)  # damages step 2
+        ok, reason = m.verify_step(2)
+        assert not ok and reason
+        restored = m.restore(_state(0.0))
+        np.testing.assert_allclose(
+            np.asarray(restored.params["w"]), 1.0)  # step 1's value
+        corrupt_dirs = glob.glob(d + "/2.corrupt*")
+        assert len(corrupt_dirs) == 1
+        assert not os.path.exists(os.path.join(d, "2"))
+        assert metrics.run_stats.checkpoint_rollbacks == 1
+        assert "2 -> 1" in metrics.run_stats.last_rollback
+        # the quarantined step's manifest is gone; step 1 restores again
+        assert m.verify_step(1) == (True, "ok")
+        m.close()
+        metrics.run_stats.reset()
+
+    def test_all_corrupt_raises_not_death_loops(self, tmp_path):
+        d = str(tmp_path / "ckpt")
+        m = CheckpointManager(d, async_save=False)
+        m.save(1, _state(1.0), wait=True)
+        corrupt_latest_checkpoint(d)
+        with pytest.raises(CheckpointCorruptionError, match="no verified"):
+            m.restore(_state(0.0))
+        m.close()
+
+    def test_explicit_corrupt_step_raises(self, tmp_path):
+        """An explicitly pinned step never silently substitutes older
+        state: corrupt it -> CheckpointCorruptionError."""
+        d, m = _two_step_dir(tmp_path)
+        corrupt_latest_checkpoint(d)
+        with pytest.raises(CheckpointCorruptionError, match="step 2"):
+            m.restore(_state(0.0), step=2)
+        m.close()
+
+    def test_legacy_dir_without_manifests_still_restores(self, tmp_path):
+        d, m = _two_step_dir(tmp_path)
+        for p in glob.glob(d + "/manifest_step_*.json"):
+            os.unlink(p)
+        restored = m.restore(_state(0.0))
+        np.testing.assert_allclose(np.asarray(restored.params["w"]), 2.0)
+        m.close()
+
+    def test_verify_disabled_by_env(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("SPARKDL_CHECKPOINT_VERIFY", "0")
+        d = str(tmp_path / "ckpt")
+        m = CheckpointManager(d, async_save=False)
+        m.save(1, _state(1.0), wait=True)
+        assert glob.glob(d + "/manifest_step_*.json") == []
+        m.close()
+
+    def test_wait_close_idempotent_and_safe_before_first_save(self, tmp_path):
+        m = CheckpointManager(str(tmp_path / "ckpt"))
+        m.wait()
+        m.wait()
+        m.close()
+        m.close()  # double close: no-op
+        m2 = CheckpointManager(str(tmp_path / "ckpt2"))
+        m2.save(1, _state(1.0), wait=False)
+        m2.wait()  # finalizes the async save's manifest
+        assert m2.verify_step(1) == (True, "ok")
+        m2.close()
+        m2.wait()  # after close: no-op, no raise
+
+    def test_fit_error_path_closes_manager_once(self, tmp_path):
+        """ISSUE 4 satellite: a failing fit closes its CheckpointManager
+        (finalizing the in-flight save + manifest) and drops the cached
+        instance so the context property can re-open."""
+        runner = XlaRunner(np=8, checkpoint_dir=str(tmp_path / "ckpt"))
+        ctx = runner.make_context()
+        rng = np.random.RandomState(0)
+
+        def data():
+            while True:
+                yield {"image": rng.randn(8, 4).astype(np.float32),
+                       "label": rng.randint(0, 3, (8,))}
+
+        def boom():
+            it = data()
+            for i, b in enumerate(it):
+                if i == 3:
+                    raise RuntimeError("UNAVAILABLE: injected")
+                yield b
+
+        with ctx.mesh:
+            with pytest.raises(RuntimeError, match="UNAVAILABLE"):
+                ctx.fit(loss_fn=softmax_cross_entropy_loss(),
+                        params={"w": rng.randn(4, 3).astype(np.float32)},
+                        tx=optax.sgd(0.1),
+                        apply_fn=lambda p, x: x @ p["w"], data=boom(),
+                        num_steps=6, checkpoint_every=2, log_every=100)
+        assert ctx._ckpt is None  # closed exactly once and dropped
+        # the save that was in flight at failure time is fully committed
+        m = CheckpointManager(str(tmp_path / "ckpt"))
+        assert m.latest_step() == 2
+        assert m.verify_step(2) == (True, "ok")
+        m.close()
+
+    def test_fit_resumes_past_corrupt_checkpoint(self, tmp_path):
+        """In-process acceptance: corrupt the latest checkpoint, rerun
+        fit(resume=True) — it rolls back to the previous verified step
+        and completes instead of death-looping."""
+        metrics.run_stats.reset()
+        ckpt = str(tmp_path / "ckpt")
+        rng = np.random.RandomState(1)
+        params = {"w": rng.randn(4, 3).astype(np.float32)}
+
+        def data(n):
+            r = np.random.RandomState(2)
+            for _ in range(n):
+                yield {"image": r.randn(8, 4).astype(np.float32),
+                       "label": r.randint(0, 3, (8,))}
+
+        kw = dict(loss_fn=softmax_cross_entropy_loss(), params=params,
+                  tx=optax.sgd(0.1), apply_fn=lambda p, x: x @ p["w"],
+                  checkpoint_every=2, log_every=100)
+        r1 = XlaRunner(np=8, checkpoint_dir=ckpt).run(
+            lambda ctx: ctx.fit(data=data(12), num_steps=4, **kw))
+        assert int(r1["state"].step) == 4
+        assert corrupt_latest_checkpoint(ckpt)
+        r2 = XlaRunner(np=8, checkpoint_dir=ckpt).run(
+            lambda ctx: ctx.fit(data=data(12), num_steps=6, **kw))
+        assert int(r2["state"].step) == 6
+        # resumed from step 2, not 4: ran 4 steps, rolled back once
+        assert r2["meter"].steps == 4
+        assert metrics.run_stats.checkpoint_rollbacks == 1
+        assert glob.glob(ckpt + "/4.corrupt*")
+        metrics.run_stats.reset()
+
+
+class TestLoadPortable:
+    def test_reports_all_mismatches_in_one_error(self, tmp_path):
+        path = str(tmp_path / "w.safetensors")
+        save_portable({"a": {"w": np.ones((2, 2), np.float32)},
+                       "extra": np.ones((1,), np.float32),
+                       "b": np.ones((3,), np.float32)}, path)
+        template = {"a": {"w": np.zeros((2, 3), np.float32)},  # mismatch
+                    "b": np.zeros((3,), np.float32),           # ok
+                    "missing1": np.zeros((1,), np.float32),
+                    "missing2": np.zeros((1,), np.float32)}
+        with pytest.raises(ValueError) as ei:
+            load_portable(template, path)
+        msg = str(ei.value)
+        # ALL problems in ONE message, with param-tree paths
+        assert "missing1" in msg and "missing2" in msg
+        assert "extra" in msg
+        assert "a/w" in msg and "(2, 2)" in msg and "(2, 3)" in msg
+
+    def test_clean_roundtrip_still_works(self, tmp_path):
+        path = str(tmp_path / "w.safetensors")
+        params = {"a": {"w": np.arange(4, dtype=np.float32).reshape(2, 2)}}
+        save_portable(params, path)
+        out = load_portable(
+            {"a": {"w": np.zeros((2, 2), np.float32)}}, path)
+        np.testing.assert_array_equal(np.asarray(out["a"]["w"]),
+                                      params["a"]["w"])
+
+
+class TestReviewRegressions:
+    """Pins for the PR-4 review findings."""
+
+    def test_legacy_steps_survive_manifest_upgrade(self, tmp_path,
+                                                   monkeypatch):
+        """Steps saved pre-manifest are valid restore points: when a
+        newer manifested step is corrupt, restore falls back to the
+        legacy step UNVERIFIED instead of quarantining it."""
+        d = str(tmp_path / "ckpt")
+        monkeypatch.setenv("SPARKDL_CHECKPOINT_VERIFY", "0")
+        m = CheckpointManager(d, async_save=False)
+        m.save(1, _state(1.0), wait=True)  # legacy: no manifest
+        m.close()
+        monkeypatch.delenv("SPARKDL_CHECKPOINT_VERIFY")
+        m2 = CheckpointManager(d, async_save=False)
+        m2.save(2, _state(2.0), wait=True)  # manifested
+        assert corrupt_latest_checkpoint(d)
+        restored = m2.restore(_state(0.0))
+        np.testing.assert_allclose(np.asarray(restored.params["w"]), 1.0)
+        assert os.path.isdir(os.path.join(d, "1"))  # NOT quarantined
+        assert glob.glob(d + "/2.corrupt*")
+        m2.close()
+
+    def test_uncommitted_partial_save_is_quarantined(self, tmp_path):
+        """A step NEWER than the newest manifest (killed between the save
+        landing and its manifest commit) is the partial-save case:
+        quarantined, fallback to the verified step. (A dir orbax never
+        committed at all is already excluded by orbax's own
+        latest_step.)"""
+        d = str(tmp_path / "ckpt")
+        m = CheckpointManager(d, async_save=False)
+        m.save(1, _state(1.0), wait=True)
+        m.save(2, _state(2.0), wait=True)
+        os.unlink(os.path.join(d, "manifest_step_2.json"))  # died pre-commit
+        restored = m.restore(_state(0.0))
+        np.testing.assert_allclose(np.asarray(restored.params["w"]), 1.0)
+        assert glob.glob(d + "/2.corrupt*")
+        m.close()
+
+    def test_restore_finalizes_inflight_async_save(self, tmp_path):
+        """restore() right after save(wait=False) must land + certify the
+        pending save, not quarantine the step orbax is still writing."""
+        d = str(tmp_path / "ckpt")
+        m = CheckpointManager(d)  # async
+        m.save(1, _state(1.0), wait=True)
+        m.save(2, _state(2.0), wait=False)
+        restored = m.restore(_state(0.0))
+        np.testing.assert_allclose(np.asarray(restored.params["w"]), 2.0)
+        assert not glob.glob(d + "/*.corrupt*")
+        assert m.verify_step(2) == (True, "ok")
+        m.close()

@@ -668,3 +668,53 @@ class TestTraceSatellite:
             pass
         ev = [e for e in rec.tail() if e["name"] == "profile_trace"]
         assert ev and ev[0]["trace_dir"] == "/tmp/sparkdl_trace_test"
+
+
+class TestDegradations:
+    """ISSUE 4: survived-fault events (retry / quarantine / rollback) are
+    timeline NARRATIVE — collected, rendered, never failure evidence."""
+
+    def _write(self, d, rank, recs):
+        with open(os.path.join(d, f"events_rank{rank}.jsonl"), "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+
+    def _recs(self):
+        return [
+            {"t": 100.0, "name": "retry", "ph": "P", "rank": 0,
+             "stage": "dispatch", "attempt": 1,
+             "error": "InjectedPreemption: UNAVAILABLE"},
+            {"t": 100.5, "name": "quarantine", "ph": "P", "rank": 0,
+             "rows": 3, "error_class": "ValueError", "total": 3},
+            {"t": 101.0, "name": "checkpoint_rollback", "ph": "P",
+             "rank": 0, "from_step": 4, "to_step": 2},
+            {"t": 102.0, "name": "step_compute", "ph": "E", "rank": 0,
+             "step": 3},
+        ]
+
+    def test_merge_timeline_collects_degradations(self, tmp_path):
+        d = str(tmp_path)
+        self._write(d, 0, self._recs() + [
+            {"t": 103.0, "name": "chaos", "ph": "P", "rank": 0,
+             "site": "step_start", "kind": "preempt", "step": 4}])
+        tl = events.merge_timeline(d)
+        kinds = [dg["kind"] for dg in tl["degradations"]]
+        assert kinds == ["retry", "quarantine", "checkpoint_rollback"]
+        # the retry's error text did NOT become failure evidence: the
+        # later chaos fire is still the first failure
+        assert tl["first_failure"]["site"] == "step_start"
+        assert tl["first_failure"]["t"] == 103.0
+        rendered = events.format_timeline(tl)
+        assert "survived degradations" in rendered
+        assert "checkpoint_rollback x1" in rendered
+
+    def test_collect_degradations_success_path(self, tmp_path):
+        d = str(tmp_path)
+        self._write(d, 0, self._recs())
+        self._write(d, 1, [{"t": 99.0, "name": "retry", "ph": "P",
+                            "rank": 1, "stage": "fetch", "attempt": 1}])
+        out = events.collect_degradations(d)
+        assert [r["name"] for r in out] == [
+            "retry", "retry", "quarantine", "checkpoint_rollback"]
+        assert out[0]["rank"] == 1  # time-ordered across ranks
+        assert events.collect_degradations(str(tmp_path / "missing")) == []

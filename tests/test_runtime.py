@@ -367,3 +367,119 @@ def test_make_mesh_topology_aware_dispatch(monkeypatch):
     monkeypatch.setattr(mu, "create_device_mesh", boom)
     grid = runtime._device_grid(fakes, [8])
     assert [d.id for d in grid] == list(range(8))
+
+
+# ---------------------------------------------------------------------------
+# run_stream fault tolerance (ISSUE 4): bounded retry, give-up, stall
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def fast_backoff(monkeypatch):
+    monkeypatch.setenv("SPARKDL_DISPATCH_BACKOFF_S", "0.01")
+    from sparkdl_tpu.runner import chaos, events, metrics
+    metrics.run_stats.reset()
+    rec = events.reset()
+    yield rec
+    chaos.uninstall()
+    events.reset()
+    metrics.run_stats.reset()
+
+
+@pytest.mark.chaos
+def test_dispatch_transient_fault_retried_once(fast_backoff):
+    """ISSUE 4 acceptance: an injected once-only dispatch preemption is
+    retried and the job succeeds, with a `retry` event recorded."""
+    from sparkdl_tpu.runner import metrics
+    from sparkdl_tpu.runner.chaos import Fault, FaultPlan, install
+    install(FaultPlan([Fault("dispatch", "preempt", prob=1.0, once=True)]))
+    r = runtime.BatchRunner(lambda b: b * 2.0, 4)
+    out = list(r.run(iter([np.ones((4, 2), np.float32),
+                           np.full((3, 2), 3.0, np.float32)])))
+    assert len(out) == 2
+    np.testing.assert_allclose(out[0], 2.0)
+    np.testing.assert_allclose(out[1], 6.0)
+    assert out[1].shape == (3, 2)  # pad rows still sliced on the retry path
+    names = [e["name"] for e in fast_backoff.tail()]
+    assert "retry" in names and "give_up" not in names
+    assert metrics.run_stats.dispatch_retries == 1
+
+
+@pytest.mark.chaos
+def test_dispatch_persistent_fault_exhausts_backoff(fast_backoff):
+    """A persistent retryable fault exhausts the budget and fails with a
+    classified error naming the stage (+ give_up event)."""
+    from sparkdl_tpu.runner import metrics
+    from sparkdl_tpu.runner.chaos import Fault, FaultPlan, install
+    from sparkdl_tpu.runner.failures import (ScoringStageError,
+                                             classify_exception)
+    install(FaultPlan([Fault("dispatch", "preempt", prob=1.0, once=False)]))
+    r = runtime.BatchRunner(lambda b: b * 2.0, 4)
+    with pytest.raises(ScoringStageError, match="stage 'dispatch'") as ei:
+        list(r.run(iter([np.ones((4, 2), np.float32)])))
+    assert ei.value.attempts == 1 + runtime.dispatch_retries_default()
+    assert classify_exception(ei.value) == "retryable"
+    evs = fast_backoff.tail()
+    assert [e["name"] for e in evs].count("retry") == \
+        runtime.dispatch_retries_default()
+    assert any(e["name"] == "give_up" and e["stage"] == "dispatch"
+               for e in evs)
+    assert metrics.run_stats.dispatch_giveups == 1
+
+
+@pytest.mark.chaos
+def test_dispatch_fatal_fault_not_retried(fast_backoff):
+    from sparkdl_tpu.runner import metrics
+    from sparkdl_tpu.runner.chaos import Fault, FaultPlan, install
+    from sparkdl_tpu.runner.failures import (ScoringStageError,
+                                             classify_exception)
+    install(FaultPlan([Fault("dispatch", "fatal", prob=1.0, once=False)]))
+    r = runtime.BatchRunner(lambda b: b * 2.0, 4)
+    with pytest.raises(ScoringStageError) as ei:
+        list(r.run(iter([np.ones((4, 2), np.float32)])))
+    assert ei.value.attempts == 1  # fatal: no retry burned
+    assert classify_exception(ei.value) == "fatal"
+    assert metrics.run_stats.dispatch_retries == 0
+
+
+def test_retries_disabled_restores_lean_path(fast_backoff, monkeypatch):
+    """SPARKDL_DISPATCH_RETRIES=0: no host copy pinned, first error
+    raises as the classified stage error with attempts=1."""
+    monkeypatch.setenv("SPARKDL_DISPATCH_RETRIES", "0")
+    from sparkdl_tpu.runner.chaos import Fault, FaultPlan, install
+    from sparkdl_tpu.runner.failures import ScoringStageError
+    install(FaultPlan([Fault("dispatch", "preempt", prob=1.0, once=True)]))
+    r = runtime.BatchRunner(lambda b: b * 2.0, 4)
+    with pytest.raises(ScoringStageError, match="1 attempt"):
+        list(r.run(iter([np.ones((4, 2), np.float32)])))
+
+
+def test_stall_watchdog_names_the_stage(fast_backoff, monkeypatch):
+    """No progress for SPARKDL_DISPATCH_TIMEOUT_S -> a classified
+    ScoringStallError naming the stage, not a silent hang. (On the
+    synchronous CPU backend the hang blocks dispatch; on TPU it would
+    surface at fetch — the watchdog covers both.)"""
+    import time as time_mod
+    from sparkdl_tpu.runner.failures import (ScoringStallError,
+                                             classify_exception)
+    r = runtime.BatchRunner(lambda b: b * 2.0, 4)
+    # warm the compile OUTSIDE the watchdog window: the timeout must
+    # bound steady-state progress, not the first-call XLA compile
+    list(r.run(iter([np.ones((4, 2), np.float32)])))
+    monkeypatch.setenv("SPARKDL_DISPATCH_TIMEOUT_S", "0.4")
+
+    def wedge(b):
+        def cb(x):
+            time_mod.sleep(2.0)
+            return np.asarray(x)
+        return jax.pure_callback(cb, jax.ShapeDtypeStruct(b.shape, b.dtype),
+                                 b)
+
+    r2 = runtime.BatchRunner(wedge, 4)
+    t0 = time_mod.perf_counter()
+    with pytest.raises(ScoringStallError, match="no progress") as ei:
+        list(r2.run(iter([np.ones((4, 2), np.float32)])))
+    assert ei.value.stage in ("dispatch", "fetch")
+    assert classify_exception(ei.value) == "retryable"
+    assert time_mod.perf_counter() - t0 < 1.9  # did NOT wait out the hang
+    assert any(e["name"] == "give_up" and e.get("stalled")
+               for e in fast_backoff.tail())

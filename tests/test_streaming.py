@@ -220,12 +220,11 @@ def test_encode_backpressure_bounds_raw_output_backlog():
         [pa.array([float(i) for i in range(n_chunks)])], ["x"])
     scorer = StreamScorer(
         StubRunner(), "y",
-        chunk_thunks=lambda rb: [
-            lambda i=i: np.asarray([[float(i)]], np.float32)
-            for i in range(rb.num_rows)],
+        make_decoder=lambda rb: (
+            lambda start, length: np.asarray([[float(start)]], np.float32)),
         encode=slow_encode,
         empty_array=lambda: pa.array([], type=pa.float64()),
-        decode_workers=0)
+        chunk_rows=1, decode_workers=0)
     [out] = list(scorer(iter([batch])))
     assert out.column(out.schema.get_field_index("y")).to_pylist() \
         == [float(i) for i in range(n_chunks)]
@@ -233,3 +232,191 @@ def test_encode_backpressure_bounds_raw_output_backlog():
     # raw chunks behind the first sleeping encode (backlog ≈ n_chunks)
     assert max(encode_backlog_seen) <= StubRunner.prefetch + 2, \
         encode_backlog_seen
+
+
+# ---------------------------------------------------------------------------
+# Fault-tolerant data plane (ISSUE 4): quarantine + dead letters + breaker
+# ---------------------------------------------------------------------------
+
+def ragged_df(parts=4, bad_rows=(5, 11), n=16):
+    """3-wide float vectors; rows in ``bad_rows`` are ragged (fail the
+    inputShape=(3,) reshape at decode time)."""
+    rows = [[float(i), float(i + 1), float(i + 2)] for i in range(n)]
+    for b in bad_rows:
+        rows[b] = [1.0]
+    df = sdl.DataFrame.fromArrow(
+        pa.table({"x": pa.array(rows, type=pa.list_(pa.float32()))}),
+        numPartitions=parts)
+    return df, rows
+
+
+def quarantining_transformer(**kw):
+    kw.setdefault("batchSize", 4)
+    return sdl.XlaTransformer(inputCol="x", outputCol="y",
+                              fn=lambda b: b * 2.0, inputShape=(3,),
+                              onError="quarantine", **kw)
+
+
+def test_quarantine_routes_bad_rows_to_dead_letters():
+    """Bad rows dead-letter with error classes; every surviving row is
+    bit-identical to a clean run; counts agree across sink, run_stats,
+    and input-minus-output."""
+    from sparkdl_tpu.runner import metrics
+    metrics.run_stats.reset()
+    df, rows = ragged_df()
+    t = quarantining_transformer()
+    out = t.transform(df).collect()
+    assert len(out) == 14
+    dead = t.deadLetters()
+    assert dead.num_rows == 2
+    assert set(dead.column("error_class").to_pylist()) == {"ValueError"}
+    assert all(m for m in dead.column("error").to_pylist())
+    # dead letters carry the ORIGINAL payloads of exactly the bad rows
+    assert sorted(len(v) for v in dead.column("x").to_pylist()) == [1, 1]
+    assert metrics.run_stats.rows_quarantined == 2
+    good = [r for i, r in enumerate(rows) if i not in (5, 11)]
+    clean = sdl.XlaTransformer(inputCol="x", outputCol="y",
+                               fn=lambda b: b * 2.0, inputShape=(3,),
+                               batchSize=4).transform(
+        sdl.DataFrame.fromPydict({"x": good})).collect()
+    np.testing.assert_array_equal(
+        np.asarray([r.y for r in out], np.float32),
+        np.asarray([r.y for r in clean], np.float32))
+    metrics.run_stats.reset()
+
+
+def test_quarantine_default_is_raise():
+    df, _ = ragged_df()
+    t = sdl.XlaTransformer(inputCol="x", outputCol="y",
+                           fn=lambda b: b * 2.0, inputShape=(3,),
+                           batchSize=4)
+    assert t.getOnError() == "raise"
+    with pytest.raises(ValueError):
+        t.transform(df).collect()
+    with pytest.raises(ValueError, match="onError"):
+        t.setOnError("ignore")
+
+
+def test_quarantine_schema_stable_across_edges():
+    """Satellite: scored + dead-letter batches round-trip through Arrow
+    with stable column types on the empty-quarantine and
+    all-rows-quarantined edges."""
+    # all rows of one partition bad (partition 2 of 4 = rows 8..11)
+    df, _ = ragged_df(bad_rows=(8, 9, 10, 11))
+    t = quarantining_transformer(batchSize=2)
+    scored = t.transform(df)
+    table = scored.toArrow()
+    assert table.num_rows == 12
+    dead_all = t.deadLetters()
+    assert dead_all.num_rows == 4
+
+    # empty quarantine: same stable schema, zero rows
+    clean_df, _ = ragged_df(bad_rows=())
+    t2 = quarantining_transformer(batchSize=2)
+    assert len(t2.transform(clean_df).collect()) == 16
+    dead_none = t2.deadLetters()
+    assert dead_none.num_rows == 0
+    assert dead_none.schema.equals(dead_all.schema)
+    assert dead_none.schema.names[-2:] == ["error_class", "error"]
+    # both round-trip through Arrow IPC with types intact
+    import pyarrow.ipc as ipc
+    for tbl in (dead_all, dead_none):
+        sink = pa.BufferOutputStream()
+        with ipc.new_stream(sink, tbl.schema) as w:
+            w.write_table(tbl)
+        back = ipc.open_stream(sink.getvalue()).read_all()
+        assert back.schema.equals(tbl.schema)
+        assert back.num_rows == tbl.num_rows
+    from sparkdl_tpu.runner import metrics
+    metrics.run_stats.reset()
+
+
+def test_quarantine_circuit_breaker_trips_fatal():
+    from sparkdl_tpu.runner.failures import (QuarantineOverflowError,
+                                             classify_exception)
+    df, _ = ragged_df(bad_rows=tuple(range(16)))  # every row bad
+    t = quarantining_transformer()
+    with pytest.raises(QuarantineOverflowError) as ei:
+        t.transform(df).collect()
+    assert classify_exception(ei.value) == "fatal"
+    from sparkdl_tpu.runner import metrics
+    metrics.run_stats.reset()
+
+
+def test_quarantine_image_payloads():
+    """Image path: a row whose pixel buffer is truncated dead-letters;
+    the rest score normally (chunk decode fails -> row fallback)."""
+    imgs = [np.full((6, 6, 3), i * 10, np.uint8) for i in range(8)]
+    structs = [imageIO.imageArrayToStruct(im, origin=f"m{i}")
+               for i, im in enumerate(imgs)]
+    structs[3] = dict(structs[3], data=structs[3]["data"][:17])  # truncated
+    df = sdl.DataFrame.fromArrow(
+        pa.table({"image": pa.array(structs, type=imageIO.imageSchema)}),
+        numPartitions=2)
+    t = sdl.XlaImageTransformer(
+        inputCol="image", outputCol="out", fn=lambda b: b.mean(axis=(1, 2)),
+        inputSize=(6, 6), batchSize=4, onError="quarantine")
+    rows = t.transform(df).collect()
+    assert [r.image["origin"] for r in rows] == \
+        [f"m{i}" for i in range(8) if i != 3]
+    dead = t.deadLetters()
+    assert dead.num_rows == 1
+    assert dead.column("image").to_pylist()[0]["origin"] == "m3"
+    from sparkdl_tpu.runner import metrics
+    metrics.run_stats.reset()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_score_chaos_smoke_script():
+    """scripts/score_chaos_smoke.py end-to-end (ISSUE 4 acceptance):
+    injected decode faults -> job completes, quarantine counts agree,
+    survivors bit-identical; injected dispatch preemption -> retried."""
+    import json
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(_REPO, "scripts", "score_chaos_smoke.py")],
+        capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, \
+        f"stdout={proc.stdout[-2000:]}\nstderr={proc.stderr[-2000:]}"
+    rec = json.loads([ln for ln in proc.stdout.strip().splitlines()
+                      if ln.startswith("{")][-1])
+    assert rec["ok"] is True
+    assert rec["survivors_bit_identical"] is True
+    assert rec["quarantine_counts_agree"] is True
+    assert rec["quarantined"] > 0
+    assert rec["dispatch_retry_events"] >= 1
+
+
+def test_schema_probe_preserves_dead_letters():
+    """Review regression: DataFrame.schema re-invokes the stream op on a
+    1-row probe; that clean pass must not wipe the dead letters of the
+    real materialization."""
+    df, _ = ragged_df()
+    t = quarantining_transformer()
+    out = t.transform(df)
+    assert len(out.collect()) == 14
+    assert t.deadLetters().num_rows == 2
+    _ = out.schema          # 1-row clean probe
+    _ = out.columns
+    assert t.deadLetters().num_rows == 2  # ledger intact
+    from sparkdl_tpu.runner import metrics
+    metrics.run_stats.reset()
+
+
+def test_circuit_breaker_has_min_rows_floor():
+    """Review regression: a corrupt cluster at the HEAD of the stream
+    must not trip the breaker when the whole-input fraction is tiny."""
+    n = 120
+    rows = [[float(i), 1.0, 2.0] for i in range(n)]
+    for b in range(4):          # first chunk: 100% bad
+        rows[b] = [0.5]
+    df = sdl.DataFrame.fromArrow(
+        pa.table({"x": pa.array(rows, type=pa.list_(pa.float32()))}),
+        numPartitions=6)
+    t = quarantining_transformer()
+    out = t.transform(df).collect()   # completes: 4/120 << 0.5 overall
+    assert len(out) == n - 4
+    assert t.deadLetters().num_rows == 4
+    from sparkdl_tpu.runner import metrics
+    metrics.run_stats.reset()
