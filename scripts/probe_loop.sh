@@ -17,7 +17,20 @@ INTERVAL="${PROBE_INTERVAL_S:-600}"
 TIMEOUT="${PROBE_TIMEOUT_S:-120}"
 LOG="PROBE_LOG"
 MEASURED_MARK=".probe_measured"
-MEASURED_OUT="${PROBE_MEASURED_OUT:-BENCH_TPU_MEASURED.json}"
+# Default the output to the NEXT FREE BENCH_TPU_MEASURED<N>.json index:
+# bench.py's _last_measured_summary ranks records by filename index
+# (unnumbered == 1 == oldest, git does not preserve mtimes), so writing a
+# new window to the unnumbered name would rank it oldest — or clobber the
+# first window's record.
+MEASURED_OUT="${PROBE_MEASURED_OUT:-}"
+if [ -z "$MEASURED_OUT" ]; then
+    MEASURED_OUT="BENCH_TPU_MEASURED.json"
+    n=2
+    while [ -e "$MEASURED_OUT" ]; do
+        MEASURED_OUT="BENCH_TPU_MEASURED${n}.json"
+        n=$((n+1))
+    done
+fi
 
 while true; do
     start=$(date +%s)

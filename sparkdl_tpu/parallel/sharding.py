@@ -88,7 +88,8 @@ def describe(params: Any, rules: Callable) -> dict[str, str]:
 # ---------------------------------------------------------------------------
 
 def transformer_tp_rules(model_axis: str = "model",
-                         data_axis: str | None = None) -> Callable:
+                         data_axis: str | None = None,
+                         mesh: Mesh | None = None) -> Callable:
     """Tensor-parallel rules for the transformer families in ``models/``:
 
     - attention q/k/v projections: shard the head (output) dim → each chip
@@ -104,7 +105,9 @@ def transformer_tp_rules(model_axis: str = "model",
 
     With ``data_axis`` set, the TP rules are extended to the 2-D
     FSDP×TP layout via :func:`fsdp_rules` (each kernel's first
-    TP-unsharded dim additionally shards over the data axis).
+    TP-unsharded dim additionally shards over the data axis; pass
+    ``mesh`` so indivisible dims — a 50257 vocab on data=4 — are skipped,
+    see the :func:`fsdp_rules` docstring).
     """
     m = model_axis
     # (/base)? skips the LoRADense wrapper segment (models/llama.py): the
@@ -119,11 +122,12 @@ def transformer_tp_rules(model_axis: str = "model",
         (r"(embed_tokens|embedding|lm_head|word_embeddings)/(embedding|kernel)",
          P(None, m)),
     ])
-    return fsdp_rules(rules, data_axis) if data_axis else rules
+    return fsdp_rules(rules, data_axis, mesh=mesh) if data_axis else rules
 
 
 def fsdp_rules(base_rules: Callable | None = None,
-               data_axis: str = "data") -> Callable:
+               data_axis: str = "data",
+               mesh: Mesh | None = None) -> Callable:
     """ZeRO-3 / FSDP-style parameter sharding, GSPMD-idiomatic: every
     >=2-D kernel additionally shards its first base-unsharded dim over
     the DATA axis, so per-chip param (and optimizer-state) residency
@@ -134,7 +138,20 @@ def fsdp_rules(base_rules: Callable | None = None,
     ``transformer_tp_rules()`` as ``base_rules`` (or just use
     ``transformer_tp_rules(data_axis=...)``); 1-D leaves (norm scales,
     biases) stay on the base layout — sharding them saves nothing and
-    costs a gather per use."""
+    costs a gather per use.
+
+    Divisibility (advisor, round 5): with ``mesh`` given, the data axis
+    is only assigned to a dim whose size divides evenly by
+    ``mesh.shape[data_axis]`` — an uneven split (a 50257-vocab embedding
+    on data=4) makes GSPMD pad-and-reshard the tensor on every use,
+    costing more than the residency it saves. Later free dims are tried
+    in order; when no dim divides, the leaf falls back to the base spec
+    (replicated over data). Limitation: WITHOUT ``mesh`` the axis extent
+    is unknown here, so the first free dim is taken unchecked (the
+    pre-fix behavior) — pass ``mesh`` whenever the layout includes
+    odd-sized tables."""
+    axis_size = int(mesh.shape[data_axis]) if mesh is not None else None
+
     def rules(path, leaf) -> P:
         base = base_rules(path, leaf) if base_rules is not None else P()
         ndim = getattr(leaf, "ndim", 0)
@@ -143,12 +160,17 @@ def fsdp_rules(base_rules: Callable | None = None,
         # a duplicate mesh axis
         if ndim < 2 or data_axis in base:
             return base
+        shape = getattr(leaf, "shape", None)
         spec = list(base) + [None] * (ndim - len(base))
         for i, s in enumerate(spec):
-            if s is None:
-                spec[i] = data_axis
-                break
-        return P(*spec)
+            if s is not None:
+                continue
+            if axis_size is not None and shape is not None \
+                    and i < len(shape) and shape[i] % axis_size:
+                continue  # uneven split: try a later free dim
+            spec[i] = data_axis
+            return P(*spec)
+        return base  # no evenly-divisible free dim: keep the base layout
 
     # forward the base TP matcher: lora_rules derives adapter specs from
     # the BASE kernel's TP dims through this attribute — adapters inherit

@@ -16,11 +16,14 @@ from .chaos import Fault, FaultPlan, InjectedFatal, InjectedFault, \
     InjectedPreemption
 from .checkpoint import CheckpointCorruptionError, CheckpointManager, \
     load_portable, save_portable
+from .data import (ArrowDataset, CheckpointableDataset, FactoryDataset,
+                   ListDataset, as_dataset)
 from .events import FlightRecorder, Timer, enable_flight_recorder, \
     merge_timeline
-from .failures import QuarantineOverflowError, ScoringStageError, \
-    ScoringStallError, TrainingDivergedError, classify_exception, \
-    classify_text, diagnose_context, exception_summary, is_retryable
+from .failures import PoisonDataError, QuarantineOverflowError, \
+    ScoringStageError, ScoringStallError, TrainingDivergedError, \
+    classify_exception, classify_text, diagnose_context, \
+    exception_summary, is_retryable
 from .launcher import GangFailure, SuperviseResult, launch, supervise
 from .metrics import MetricsLogger, StepTimeStats, ThroughputMeter, \
     debug_mode, global_step_stats, peak_flops_per_chip, run_stats, \
@@ -43,7 +46,9 @@ __all__ = [
     "load_portable",
     "classify_exception", "classify_text", "is_retryable",
     "diagnose_context", "TrainingDivergedError", "QuarantineOverflowError",
-    "ScoringStallError", "ScoringStageError",
+    "ScoringStallError", "ScoringStageError", "PoisonDataError",
+    "CheckpointableDataset", "ListDataset", "FactoryDataset",
+    "ArrowDataset", "as_dataset",
     "Fault", "FaultPlan", "InjectedFault", "InjectedPreemption",
     "InjectedFatal",
     "launch", "supervise", "GangFailure", "SuperviseResult",

@@ -15,7 +15,13 @@ executed".
 Sites (where the runner consults the plan):
 
 - ``step_start``       — top of ``RunnerContext.fit``'s step loop
-- ``batch_fetch``      — after a host batch is drawn (``nan`` poisons it)
+- ``batch_fetch``      — after a host batch is drawn (``nan`` poisons it);
+  the hook's ``step`` is the TRAIN step
+- ``data_fetch``       — inside ``CheckpointableDataset.indexed()``
+  (``runner/data.py``) as each batch is drawn; the hook's ``step`` is the
+  dataset's GLOBAL BATCH INDEX, so a fault can target one specific batch
+  deterministically across supervised restarts (the poison-batch
+  quarantine scenario)
 - ``checkpoint_save``  — inside ``CheckpointManager.save``
 - ``checkpoint_restore`` — entry of ``CheckpointManager.restore``
   (``corrupt`` truncates/flips the latest on-disk checkpoint here)
@@ -33,6 +39,12 @@ Kinds (what happens when a fault fires):
 - ``fatal``   — raise an ``INVALID_ARGUMENT``-shaped program error (no retry)
 - ``nan``     — poison the batch's float leaves with NaN (``batch_fetch``
   only; exercises the train loop's divergence guard)
+- ``poison``  — the deterministic poison-record: NaN the batch's float
+  leaves, or raise ``InjectedFatal`` when the batch has none to poison
+  (``data_fetch``/``batch_fetch``). Use ``once=False`` so the same batch
+  re-poisons on every restart — that recurrence is what the supervisor's
+  quarantine correlates on; ``nan`` + ``once`` models a one-off flake
+  instead
 - ``hang``    — sleep ``hang_s`` (exercises the heartbeat watchdog)
 - ``sigkill`` — ``SIGKILL`` the calling process (multi-process gang tests)
 - ``corrupt`` — truncate + bit-flip the newest checkpoint under the
@@ -68,8 +80,9 @@ __all__ = ["Fault", "FaultPlan", "InjectedFault", "InjectedPreemption",
 CHAOS_ENV = "SPARKDL_CHAOS"
 
 SITES = ("step_start", "checkpoint_save", "batch_fetch", "collective",
-         "worker", "decode", "dispatch", "checkpoint_restore")
-KINDS = ("preempt", "fatal", "nan", "hang", "sigkill", "corrupt")
+         "worker", "decode", "dispatch", "checkpoint_restore",
+         "data_fetch")
+KINDS = ("preempt", "fatal", "nan", "hang", "sigkill", "corrupt", "poison")
 
 
 class InjectedFault(RuntimeError):
@@ -120,6 +133,11 @@ class Fault:
         if self.kind == "nan" and self.site != "batch_fetch":
             raise ValueError("kind='nan' only poisons batches — use "
                              "site='batch_fetch'")
+        if self.kind == "poison" and self.site not in ("data_fetch",
+                                                       "batch_fetch"):
+            raise ValueError("kind='poison' poisons drawn batches — use "
+                             "site='data_fetch' (batch-index targeted) or "
+                             "'batch_fetch'")
         if self.kind == "corrupt" and self.site != "checkpoint_restore":
             raise ValueError("kind='corrupt' damages on-disk checkpoints — "
                              "use site='checkpoint_restore'")
@@ -264,6 +282,14 @@ def _execute(f: Fault, site: str, step, batch, path: str | None = None):
             f"INVALID_ARGUMENT: injected program error ({where})")
     if f.kind == "nan":
         return _poison(batch)
+    if f.kind == "poison":
+        poisoned = _poison(batch)
+        if batch is None or poisoned is batch:
+            # Nothing to NaN (no batch / no float leaves): the poison
+            # record must still kill the step deterministically.
+            raise InjectedFatal(
+                f"INVALID_ARGUMENT: injected poison batch ({where})")
+        return poisoned
     if f.kind == "hang":
         time.sleep(f.hang_s)
         return batch
@@ -319,18 +345,28 @@ def corrupt_latest_checkpoint(directory: str | None) -> list[str]:
 
 def _poison(batch):
     """NaN every float leaf of a host-numpy pytree (dict/list/tuple/array);
-    integer leaves (labels, ids) pass through untouched."""
+    integer leaves (labels, ids) pass through untouched. Returns ``batch``
+    itself (same identity) when there was no float leaf to poison, so the
+    ``poison`` kind can tell "nothing happened" and raise instead."""
     import numpy as np
-    if batch is None:
-        return None
-    if isinstance(batch, dict):
-        return {k: _poison(v) for k, v in batch.items()}
-    if isinstance(batch, (list, tuple)):
-        return type(batch)(_poison(v) for v in batch)
-    arr = np.asarray(batch)
-    if np.issubdtype(arr.dtype, np.floating):
-        return np.full_like(arr, np.nan)
-    return batch
+    changed = False
+
+    def rec(x):
+        nonlocal changed
+        if x is None:
+            return None
+        if isinstance(x, dict):
+            return {k: rec(v) for k, v in x.items()}
+        if isinstance(x, (list, tuple)):
+            return type(x)(rec(v) for v in x)
+        arr = np.asarray(x)
+        if np.issubdtype(arr.dtype, np.floating):
+            changed = True
+            return np.full_like(arr, np.nan)
+        return x
+
+    out = rec(batch)
+    return out if changed else batch
 
 
 # -- process-global active plan ---------------------------------------------

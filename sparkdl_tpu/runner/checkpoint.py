@@ -20,6 +20,14 @@ restart resumes slightly older instead of death-looping on a checkpoint
 that can never load. ``SPARKDL_CHECKPOINT_VERIFY=0`` disables manifests and
 verification (the pre-ISSUE-4 behavior); directories with no manifests at
 all (legacy runs) restore unverified for compatibility.
+
+Data cursor (ISSUE 5): ``save(..., data_cursor=)`` rides the training
+data plane's position (``runner/data.py``) in the same manifest, CRC'd
+over its canonical JSON; ``data_cursor(step)`` verifies and returns it on
+resume so the dataset restarts at the exact batch. Legacy manifests
+without one (or a corrupt cursor) return None and record an
+``unverified_data_cursor`` degradation — the run resumes, the gap is on
+record.
 """
 
 from __future__ import annotations
@@ -52,6 +60,14 @@ def _verify_enabled() -> bool:
         not in ("0", "false", "no")
 
 
+def _cursor_crc(cursor: dict) -> int:
+    """CRC32 over the cursor's canonical JSON — the data cursor is
+    verified on restore exactly like the checkpoint's files are."""
+    import json
+    return zlib.crc32(
+        json.dumps(cursor, sort_keys=True, default=str).encode())
+
+
 def _crc32_file(path: str, chunk: int = 1 << 20) -> int:
     crc = 0
     with open(path, "rb") as f:
@@ -82,7 +98,9 @@ class CheckpointManager:
         opts = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep, enable_async_checkpointing=async_save)
         self._mngr = ocp.CheckpointManager(self.directory, options=opts)
-        self._pending_manifest: int | None = None
+        # (step, data_cursor | None) of the in-flight async save whose
+        # manifest is still owed; None when nothing is pending.
+        self._pending_manifest: tuple[int, dict | None] | None = None
         self._closed = False
 
     # -- manifests ---------------------------------------------------------
@@ -102,11 +120,17 @@ class CheckpointManager:
         except OSError:
             return []
 
-    def _write_manifest(self, step: int):
+    def _write_manifest(self, step: int, data_cursor: dict | None = None):
         """Walk the landed step dir and commit its manifest atomically —
         relative path, byte size, CRC32 per file. Reading every file back
         costs one pass of I/O per save; that is the price of knowing a
-        restore-time mismatch means *corruption*, not bad luck."""
+        restore-time mismatch means *corruption*, not bad luck.
+
+        ``data_cursor`` (ISSUE 5): the training data plane's position
+        after the last batch consumed by a completed step, CRC'd over its
+        canonical JSON like everything else in the manifest — a restore
+        that resumes the model at this step resumes the *data* at exactly
+        the right batch too."""
         from . import events
         step_dir = self._step_dir(step)
         if not os.path.isdir(step_dir):
@@ -122,8 +146,11 @@ class CheckpointManager:
                         "crc32": _crc32_file(p)})
                 except OSError:
                     return  # step GC'd/moved under us: no manifest
-        events.atomic_write_json(
-            self._manifest_path(step), {"step": step, "files": files})
+        manifest: dict = {"step": step, "files": files}
+        if data_cursor is not None:
+            manifest["data_cursor"] = data_cursor
+            manifest["data_cursor_crc32"] = _cursor_crc(data_cursor)
+        events.atomic_write_json(self._manifest_path(step), manifest)
 
     def _prune_manifests(self):
         """Drop manifests whose step dir is gone (orbax max_to_keep GC) —
@@ -147,10 +174,11 @@ class CheckpointManager:
     def _finalize_pending(self):
         """Commit the manifest of the last async save once it has landed.
         Caller must have waited (``wait_until_finished``) first."""
-        step, self._pending_manifest = self._pending_manifest, None
-        if step is None or not _verify_enabled():
+        pending, self._pending_manifest = self._pending_manifest, None
+        if pending is None or not _verify_enabled():
             return
-        self._write_manifest(step)
+        step, cursor = pending
+        self._write_manifest(step, data_cursor=cursor)
         self._prune_manifests()
 
     def _manifest_mode(self) -> bool:
@@ -221,8 +249,42 @@ class CheckpointManager:
             pass
         return dst
 
+    def data_cursor(self, step: int) -> dict | None:
+        """The verified data cursor saved with ``step``'s manifest, or
+        None — with an ``unverified_data_cursor`` degradation event
+        recorded — when the manifest predates cursor support (legacy
+        runs), its cursor CRC mismatches, or there is no manifest at all.
+        A None return means the caller's dataset starts from its own
+        current position and batches before the restored step may be
+        re-consumed (exactly the pre-ISSUE-5 behavior, now *recorded*
+        instead of silent)."""
+        from . import events
+        import json
+        reason = None
+        try:
+            with open(self._manifest_path(step)) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            reason = "no readable manifest for step"
+            manifest = {}
+        cursor = manifest.get("data_cursor")
+        if reason is None and cursor is None:
+            reason = "manifest has no data cursor (pre-cursor save)"
+        if reason is None and \
+                manifest.get("data_cursor_crc32") != _cursor_crc(cursor):
+            reason = "data cursor checksum mismatch"
+            cursor = None
+        if reason is not None:
+            log.warning("resuming step %d without a verified data cursor "
+                        "(%s): earlier batches may be re-consumed",
+                        step, reason)
+            events.event("unverified_data_cursor", step=step, reason=reason)
+            return None
+        return cursor
+
     # -- save/restore ------------------------------------------------------
-    def save(self, step: int, state: Any, wait: bool = False):
+    def save(self, step: int, state: Any, wait: bool = False,
+             data_cursor: dict | None = None):
         import orbax.checkpoint as ocp
 
         from . import chaos, events
@@ -243,7 +305,7 @@ class CheckpointManager:
             if _has_leaves(state.model_state):
                 payload["model_state"] = state.model_state
             self._mngr.save(step, args=ocp.args.StandardSave(payload))
-            self._pending_manifest = step
+            self._pending_manifest = (step, data_cursor)
             if wait:
                 self._mngr.wait_until_finished()
                 self._finalize_pending()

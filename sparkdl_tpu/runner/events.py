@@ -116,6 +116,19 @@ class _Span(Timer):
             block_err = be
         end = dict(self._attrs)
         end["dur_s"] = round(self.seconds, 6)
+        if exc is not None:
+            # Exactly-once data plane (ISSUE 5): a draw-time failure is
+            # tagged by the dataset with the batch being drawn
+            # (data._tag_batch). The span that observed it is usually the
+            # timeline's EARLIEST error evidence — it must carry the
+            # attribution, or the supervisor's poison-batch quarantine
+            # never sees a batch_index on first_failure.
+            bi = getattr(exc, "_sparkdl_batch_index", None)
+            if bi is not None:
+                end["batch_index"] = bi
+                ep = getattr(exc, "_sparkdl_batch_epoch", None)
+                if ep is not None:
+                    end["epoch"] = ep
         if exc_type is not None:
             if exc_type in (StopIteration, GeneratorExit):
                 # Normal stream exhaustion (fit's data_fetch span around
@@ -349,10 +362,18 @@ _EVENT_FILE_RE = re.compile(r"events_rank(\d+)\.jsonl$")
 _POSTMORTEM_FILE_RE = re.compile(r"postmortem_rank(\d+)\.json$")
 GANG_TIMELINE_FILE = "gang_timeline.json"
 _MERGE_TAIL_BYTES = 1 << 20  # per-rank read cap when merging timelines
-# Survived-fault narrative (ISSUE 4): engaged-and-recovered machinery.
+# Survived-fault narrative (ISSUE 4/5): engaged-and-recovered machinery.
 # `give_up` is NOT here — an exhausted retry budget is failure evidence.
+# ISSUE 5 adds the training data plane's narrative: a resume from
+# checkpoint after a gang death (`train_resume`), a quarantined poison
+# batch (`train_batch_quarantined`, emitted supervisor-side), the skips
+# it causes on later attempts (`train_batch_skipped`), and a resume that
+# could not verify a data cursor (`unverified_data_cursor` — legacy
+# manifest or CRC mismatch: batches before the restored step re-consume).
 _DEGRADATION_EVENTS = ("retry", "quarantine", "checkpoint_rollback",
-                       "checkpoint_quarantine")
+                       "checkpoint_quarantine", "train_resume",
+                       "train_batch_quarantined", "train_batch_skipped",
+                       "unverified_data_cursor")
 
 
 def atomic_write_json(path: str, obj) -> str:
@@ -492,9 +513,17 @@ def merge_timeline(event_dir: str, heartbeat_dir: str | None = None,
             ranks[rank]["tail_truncated"] = True
         for r in recs:
             if r.get("name") == "chaos":
-                errors.append({"t": r.get("t", 0), "rank": rank,
-                               "site": r.get("site"), "step": r.get("step"),
-                               "error": f"injected {r.get('kind')}"})
+                e = {"t": r.get("t", 0), "rank": rank,
+                     "site": r.get("site"), "step": r.get("step"),
+                     "error": f"injected {r.get('kind')}"}
+                # At the data_fetch site the hook's step IS the dataset's
+                # global batch index — surface it so the supervisor can
+                # correlate consecutive failures to one batch (the
+                # poison-batch quarantine trigger).
+                if r.get("site") == "data_fetch" \
+                        and r.get("step") is not None:
+                    e["batch_index"] = r.get("step")
+                errors.append(e)
             elif r.get("name") == "restart":
                 # An in-process restart (run_with_restarts) RECOVERED from
                 # its error — second-tier evidence only, or it would
@@ -519,9 +548,12 @@ def merge_timeline(event_dir: str, heartbeat_dir: str | None = None,
                                                 if k not in ("t", "ph",
                                                              "rank")}})
             elif "error" in r:
-                errors.append({"t": r.get("t", 0), "rank": rank,
-                               "site": r.get("name"), "step": r.get("step"),
-                               "error": r["error"]})
+                e = {"t": r.get("t", 0), "rank": rank,
+                     "site": r.get("name"), "step": r.get("step"),
+                     "error": r["error"]}
+                if r.get("batch_index") is not None:
+                    e["batch_index"] = r.get("batch_index")
+                errors.append(e)
     for fn in names:
         m = _POSTMORTEM_FILE_RE.match(fn)
         if not m:
@@ -537,16 +569,20 @@ def merge_timeline(event_dir: str, heartbeat_dir: str | None = None,
         err = pm.get("error")
         entry["postmortem"] = {"t": pm.get("t"), "error": err,
                                "site": pm.get("site"),
-                               "step": pm.get("step")}
+                               "step": pm.get("step"),
+                               "batch_index": pm.get("batch_index")}
         if entry["last_step"] is None and pm.get("step") is not None:
             entry["last_step"] = pm.get("step")
         if err:
             msg = err.get("message", "") if isinstance(err, dict) else \
                 str(err)
             typ = err.get("type", "") if isinstance(err, dict) else ""
-            errors.append({"t": pm.get("t", 0), "rank": rank,
-                           "site": pm.get("site"), "step": pm.get("step"),
-                           "error": f"{typ}: {msg}"[:300].strip(": ")})
+            e = {"t": pm.get("t", 0), "rank": rank,
+                 "site": pm.get("site"), "step": pm.get("step"),
+                 "error": f"{typ}: {msg}"[:300].strip(": ")}
+            if pm.get("batch_index") is not None:
+                e["batch_index"] = pm.get("batch_index")
+            errors.append(e)
     if heartbeat_dir:
         try:
             hb_names = os.listdir(heartbeat_dir)
@@ -647,6 +683,8 @@ def format_timeline(tl: dict) -> str:
             f"gang timeline: first failure on rank {ff['rank']} at "
             f"site {ff.get('site') or '?'}"
             + (f" step {ff['step']}" if ff.get("step") is not None else "")
+            + (f" batch {ff['batch_index']}"
+               if ff.get("batch_index") is not None else "")
             + (f" ({ff['error']})" if ff.get("error") else ""))
     elif stalled is not None:
         line = (f"gang timeline: no terminal error recorded; rank "

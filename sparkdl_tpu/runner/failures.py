@@ -76,6 +76,29 @@ class QuarantineOverflowError(RuntimeError):
         self.max_frac = max_frac
 
 
+class PoisonDataError(RuntimeError):
+    """Fatal: the supervisor's poison-batch circuit breaker tripped
+    (ISSUE 5). More than ``SPARKDL_MAX_SKIPPED_BATCHES`` training batches
+    were quarantined as deterministic gang-killers — past that the
+    *dataset* is systematically bad (wrong schema, corrupt shard), not
+    occasionally poisoned, and skipping ever more of it would silently
+    train on a different distribution. Restarting re-quarantines, so
+    retrying burns the budget for nothing.
+    """
+
+    def __init__(self, quarantined: list, max_skipped: int,
+                 last_failure: str | None = None):
+        super().__init__(
+            f"poison-batch circuit breaker: {len(quarantined)} training "
+            f"batch(es) already quarantined ({sorted(quarantined)}), "
+            f"refusing to skip another (max {max_skipped}); the dataset "
+            "is systematically bad, not occasionally poisoned "
+            "(SPARKDL_MAX_SKIPPED_BATCHES raises the threshold)"
+            + (f"; last failure: {last_failure}" if last_failure else ""))
+        self.quarantined = list(quarantined)
+        self.max_skipped = max_skipped
+
+
 class ScoringStallError(RuntimeError):
     """The scoring pipeline's in-flight window made no fetch progress for
     ``SPARKDL_DISPATCH_TIMEOUT_S`` — a wedged device/interconnect surfaces
@@ -119,7 +142,8 @@ def classify_exception(exc: BaseException) -> str:
     """
     if isinstance(exc, KeyboardInterrupt):
         return "fatal"
-    if isinstance(exc, (TrainingDivergedError, QuarantineOverflowError)):
+    if isinstance(exc, (TrainingDivergedError, QuarantineOverflowError,
+                        PoisonDataError)):
         return "fatal"
     if isinstance(exc, ScoringStallError):
         return "retryable"
@@ -161,7 +185,7 @@ _FATAL_TRACEBACK_NAMES = ("ValueError", "TypeError", "KeyError",
                           "AssertionError", "AttributeError", "IndexError",
                           "ModuleNotFoundError", "ImportError",
                           "NotImplementedError", "TrainingDivergedError",
-                          "QuarantineOverflowError")
+                          "QuarantineOverflowError", "PoisonDataError")
 
 
 def classify_text(text: str) -> str:

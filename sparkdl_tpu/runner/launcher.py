@@ -26,6 +26,12 @@ supplies the missing pieces — starting those N processes on one machine
   failure. A :class:`~sparkdl_tpu.runner.chaos.FaultPlan` passed to
   ``supervise`` is serialized into the workers' env (``SPARKDL_CHAOS``), so
   every one of these paths is testable with zero user-script changes.
+- **Poison-batch quarantine** (ISSUE 5): two consecutive gang failures
+  attributed by the merged timeline to the same ``(step, batch_index)``
+  mark that batch a deterministic gang-killer; the supervisor appends it
+  to the workers' dataset skip-list (``SPARKDL_SKIP_BATCHES`` →
+  ``runner/data.py``) and relaunches without burning the restart budget,
+  bounded by ``SPARKDL_MAX_SKIPPED_BATCHES`` (fatal ``PoisonDataError``).
 
 Contract: ``launch(script, np=N)`` spawns N copies of ``python script`` with
 the coordination env set:
@@ -53,6 +59,7 @@ CLI: ``python -m sparkdl_tpu.runner.launcher --np 2 [--restarts R]
 from __future__ import annotations
 
 import dataclasses
+import json
 import logging
 import os
 import shutil
@@ -66,6 +73,12 @@ import time
 from . import events as events_lib
 from . import failures
 from .chaos import FaultPlan
+# data is jax-free (stdlib + lazy numpy): safe in the supervisor process.
+from .data import SKIP_ENV, env_skip_list
+from .failures import PoisonDataError
+
+MAX_SKIP_ENV = "SPARKDL_MAX_SKIPPED_BATCHES"
+_DEFAULT_MAX_SKIPPED = 16
 
 __all__ = ["launch", "supervise", "free_port", "GangFailure",
            "SuperviseResult"]
@@ -107,6 +120,9 @@ class SuperviseResult:
     attempts: int
     failure_kinds: list
     degradations: list = dataclasses.field(default_factory=list)
+    # Poison batches appended to the dataset skip-list across restarts
+    # (ISSUE 5): global batch indices the final attempt trained WITHOUT.
+    quarantined_batches: list = dataclasses.field(default_factory=list)
 
     @property
     def last_failure_kind(self) -> str | None:
@@ -118,6 +134,33 @@ class SuperviseResult:
         newest on disk (corrupt step quarantined + rollback)."""
         return any(d.get("name") == "checkpoint_rollback"
                    for d in self.degradations)
+
+
+def _batch_signature(err: "GangFailure") -> tuple | None:
+    """(step, batch_index) the gang timeline attributes the failure to, or
+    None when no batch evidence exists. Two consecutive attempts dying
+    with the SAME signature is the poison-batch trigger: a transient
+    fault lands elsewhere on the replayed stream, a deterministic poison
+    batch kills the gang at the identical position every time."""
+    ff = (err.timeline or {}).get("first_failure") or {}
+    bi = ff.get("batch_index")
+    if bi is None:
+        return None
+    try:
+        return (ff.get("step"), int(bi))
+    except (TypeError, ValueError):
+        return None
+
+
+def _record_batch_quarantine():
+    """run_stats counter for a quarantined training batch — lazy import
+    (metrics pulls jax; the supervisor must stay importable jax-free, and
+    merely importing metrics is inert, same rule as chaos._record_fault)."""
+    try:
+        from . import metrics as metrics_lib
+        metrics_lib.run_stats.record_batch_quarantine()
+    except Exception:
+        pass
 
 
 def free_port() -> int:
@@ -536,7 +579,9 @@ def supervise(script: str, np: int = 2, args: list[str] | None = None,
               heartbeat_dir: str | None = None, capture: bool = True,
               plan: FaultPlan | None = None,
               retry_all: bool = False,
-              event_dir: str | None = None) -> SuperviseResult:
+              event_dir: str | None = None,
+              quarantine_batches: bool = True,
+              max_skipped_batches: int | None = None) -> SuperviseResult:
     """Budgeted checkpoint-restart supervision of a worker gang — the
     multi-process twin of ``XlaRunner.run_with_restarts`` (SURVEY.md §5.3).
 
@@ -569,6 +614,23 @@ def supervise(script: str, np: int = 2, args: list[str] | None = None,
     every gang failure carries the merged timeline — which rank failed or
     stalled first, at what step, at which site. The temp dir is kept on
     the give-up path for postmortems, removed on success.
+
+    **Poison-batch quarantine** (ISSUE 5, ``quarantine_batches=True``):
+    when two *consecutive* failures are attributed by the gang timeline to
+    the same ``(step, batch_index)`` — the signature of a deterministic
+    poison batch, since a transient fault lands elsewhere on the replayed
+    stream — the batch is appended to the workers' dataset skip-list
+    (``SPARKDL_SKIP_BATCHES``) and the gang relaunches *without consuming
+    the restart budget* (excluding the poison is progress, not a retry).
+    A batch-attributed FATAL failure (e.g. ``TrainingDivergedError`` from
+    a NaN-producing record) gets one budget-counted probe restart to test
+    determinism instead of giving up outright; batch-less failures keep
+    the plain restart/fatal policy unchanged. Each quarantine records a
+    ``train_batch_quarantined`` degradation (``SuperviseResult``,
+    run_stats, flight-recorder event). ``max_skipped_batches`` (default
+    ``SPARKDL_MAX_SKIPPED_BATCHES``, 16) bounds the skip-list: past it a
+    fatal :class:`~sparkdl_tpu.runner.failures.PoisonDataError` stops the
+    supervisor from eating the dataset one batch at a time.
     """
     if np < 1:
         raise ValueError(f"np must be >= 1, got {np}")
@@ -599,7 +661,21 @@ def supervise(script: str, np: int = 2, args: list[str] | None = None,
     os.makedirs(event_dir, exist_ok=True)
     env["SPARKDL_EVENT_DIR"] = event_dir
 
-    restarts = 0
+    if max_skipped_batches is None:
+        try:
+            max_skipped_batches = int(
+                env.get(MAX_SKIP_ENV)
+                or os.environ.get(MAX_SKIP_ENV, _DEFAULT_MAX_SKIPPED))
+        except ValueError:
+            max_skipped_batches = _DEFAULT_MAX_SKIPPED
+    skip_list = sorted(set(env_skip_list(env) if SKIP_ENV in env
+                           else env_skip_list()))
+    quarantined: list[int] = []
+    extra_degradations: list[dict] = []  # supervisor-side (quarantines)
+    prev_sig: tuple | None = None  # last failure's (step, batch_index)
+
+    restarts = 0      # every relaunch, for the recovery ledger
+    budget_used = 0   # failure-driven relaunches, checked against budget
     kinds: list[str] = []
     while True:
         # (_run_gang clears attempt N-1's heartbeats/traces before spawning)
@@ -609,12 +685,15 @@ def supervise(script: str, np: int = 2, args: list[str] | None = None,
         if status == "ok":
             # Survived-fault ledger BEFORE cleanup: a gang that recovered
             # by rolling back a corrupt checkpoint / retrying a flaky
-            # dispatch / quarantining rows reports it (ISSUE 4 — a
-            # degradation is recorded, not silently absorbed).
+            # dispatch / quarantining rows or poison batches reports it
+            # (ISSUE 4/5 — a degradation is recorded, never silently
+            # absorbed).
             try:
                 degradations = events_lib.collect_degradations(event_dir)
             except Exception:
                 degradations = []
+            degradations = sorted(degradations + extra_degradations,
+                                  key=lambda d: d.get("t", 0))
             if degradations:
                 log.warning(
                     "supervise: gang succeeded after surviving %d "
@@ -626,25 +705,116 @@ def supervise(script: str, np: int = 2, args: list[str] | None = None,
             return SuperviseResult(results=results, restarts=restarts,
                                    attempts=restarts + 1,
                                    failure_kinds=kinds,
-                                   degradations=degradations)
+                                   degradations=degradations,
+                                   quarantined_batches=list(quarantined))
         err = _failure(status, results, info, timeout_s, capture,
                        event_dir=event_dir, heartbeat_dir=heartbeat_dir)
+        sig = _batch_signature(err) if quarantine_batches else None
+        # Correlate on the BATCH INDEX: the signature's step component is
+        # reported but not compared — evidence sources disagree on it (a
+        # data_fetch chaos event's step IS the batch index, a
+        # postmortem's is the train step), and a source-selection
+        # artifact between two attempts must not hide a genuinely
+        # deterministic poison. The batch index is the quarantine key
+        # and identical across sources by construction.
+        same_batch = (sig is not None and prev_sig is not None
+                      and sig[1] == prev_sig[1])
+        if same_batch and sig[1] in (skip_list or []):
+            # The batch is ALREADY on the skip-list and still killed the
+            # gang: the dataset cannot actually skip it (a poison that
+            # raises while DRAWING from a non-seekable source dies before
+            # the skip check can act — see data.py's skip-list notes).
+            # Re-quarantining would alternate budget-restart/free-relaunch
+            # forever; fall through to the normal policy and fail fast
+            # with the story on record.
+            log.error(
+                "supervise: batch %s is on the skip-list but still kills "
+                "the gang (source cannot skip it — draw-time poison in a "
+                "non-seekable source?); not re-quarantining", sig[1])
+            sig = None
+            same_batch = False
+        if same_batch:
+            # Two consecutive failures at the SAME (step, batch_index):
+            # a deterministic poison batch, not a flake. Quarantine it —
+            # append to the workers' skip-list and relaunch WITHOUT
+            # consuming the restart budget (excluding the poison is
+            # progress; the budget is for failures we can't act on).
+            step_, batch_index = sig
+            if len(quarantined) >= max_skipped_batches:
+                _prune_empty_gang_dir(adopted_dir)
+                raise PoisonDataError(quarantined, max_skipped_batches,
+                                      last_failure=str(err)[:300]) from err
+            quarantined.append(batch_index)
+            skip_list = sorted(set(skip_list) | {batch_index})
+            env[SKIP_ENV] = json.dumps(skip_list)
+            kinds.append("quarantined")
+            _record_batch_quarantine()
+            events_lib.event("train_batch_quarantined",
+                             batch_index=batch_index, step=step_,
+                             skip_list=skip_list)
+            # Same record shape as collect_degradations' raw events
+            # ("name" key), so SuperviseResult.degradations is uniform
+            # whether a degradation came from a rank's stream or from the
+            # supervisor itself.
+            extra_degradations.append({
+                "t": round(time.time(), 6), "rank": None,
+                "name": "train_batch_quarantined",
+                "batch_index": batch_index, "step": step_,
+                "error": (err.timeline or {}).get(
+                    "first_failure", {}).get("error"),
+                "skip_list": list(skip_list)})
+            prev_sig = None  # correlation window restarts fresh
+            restarts += 1
+            log.warning(
+                "supervise: two consecutive failures attributed to batch "
+                "%s (step %s) — quarantined onto the skip-list %s; "
+                "relaunching (restart %d, budget untouched at %d/%d)\n%s",
+                batch_index, step_, skip_list, restarts, budget_used,
+                max_restarts, str(err)[:600])
+            time.sleep(backoff_s)
+            continue
         kinds.append(err.kind)
-        if (err.kind == "fatal" and not retry_all) \
-                or restarts >= max_restarts:
-            err.args = (f"{err}\n(supervise: giving up after {restarts} "
-                        f"restart(s) of budget {max_restarts}; failure "
-                        f"kinds: {kinds})",)
+        fatal = err.kind == "fatal" and not retry_all
+        if fatal and sig is not None and budget_used < max_restarts:
+            # Batch-attributed fatal failure (a NaN-producing record
+            # raising TrainingDivergedError looks exactly like a user
+            # bug): spend ONE budgeted probe restart to test whether it
+            # recurs at the same batch before giving up. Recurrence →
+            # quarantine above (which is also why reaching here implies
+            # sig != prev_sig: a NEW signature always deserves its probe,
+            # even right after an unrelated batch-attributed failure);
+            # ever-changing fatal signatures stay bounded by the budget.
+            prev_sig = sig
+            restarts += 1
+            budget_used += 1
+            backoff = backoff_s * (2 ** (budget_used - 1))
+            log.warning(
+                "supervise: fatal gang failure attributed to batch %s "
+                "(step %s) — probing for a deterministic poison batch "
+                "with one restart (%d/%d) in %.1fs\n%s", sig[1], sig[0],
+                budget_used, max_restarts, backoff, str(err)[:600])
+            time.sleep(backoff)
+            continue
+        if fatal or budget_used >= max_restarts:
+            # budget_used, not restarts: quarantine relaunches were free
+            # and must not read as a budget overrun in the postmortem.
+            total = (f" ({restarts} relaunches total incl. quarantines)"
+                     if restarts != budget_used else "")
+            err.args = (f"{err}\n(supervise: giving up after {budget_used} "
+                        f"restart(s) of budget {max_restarts}{total}; "
+                        f"failure kinds: {kinds})",)
             # Same as launch(): an adopted subdir holding no evidence is
             # just clutter in the user's telemetry dir (rmdir-only-when-
             # empty — real traces always survive the give-up path).
             _prune_empty_gang_dir(adopted_dir)
             raise err
+        prev_sig = sig
         restarts += 1
-        backoff = backoff_s * (2 ** (restarts - 1))
+        budget_used += 1
+        backoff = backoff_s * (2 ** (budget_used - 1))
         log.warning("supervise: gang attempt %d failed (%s); relaunching "
                     "in %.1fs (restart %d/%d)\n%s", restarts, err.kind,
-                    backoff, restarts, max_restarts, str(err)[:1000])
+                    backoff, budget_used, max_restarts, str(err)[:1000])
         time.sleep(backoff)
 
 

@@ -48,6 +48,9 @@ class RunStats:
     dispatch_giveups: int = 0
     checkpoint_rollbacks: int = 0
     last_rollback: str | None = None
+    # Training data plane (ISSUE 5): poison batches the supervisor
+    # quarantined onto the dataset skip-list.
+    train_batches_quarantined: int = 0
 
     def record_restart(self):
         self.restarts += 1
@@ -69,6 +72,9 @@ class RunStats:
         else:
             self.dispatch_retries += 1
 
+    def record_batch_quarantine(self, n: int = 1):
+        self.train_batches_quarantined += int(n)
+
     def record_rollback(self, from_step, to_step, reason: str | None = None):
         self.checkpoint_rollbacks += 1
         self.last_rollback = (f"step {from_step} -> {to_step}"
@@ -84,7 +90,8 @@ class RunStats:
                 "dispatch_retries": self.dispatch_retries,
                 "dispatch_giveups": self.dispatch_giveups,
                 "checkpoint_rollbacks": self.checkpoint_rollbacks,
-                "last_rollback": self.last_rollback}
+                "last_rollback": self.last_rollback,
+                "train_batches_quarantined": self.train_batches_quarantined}
 
     def degraded(self) -> bool:
         """True when any fault-tolerance machinery actually engaged —
@@ -92,7 +99,8 @@ class RunStats:
         every record."""
         return bool(self.restarts or self.faults_injected
                     or self.rows_quarantined or self.dispatch_retries
-                    or self.dispatch_giveups or self.checkpoint_rollbacks)
+                    or self.dispatch_giveups or self.checkpoint_rollbacks
+                    or self.train_batches_quarantined)
 
     def reset(self):
         self.restarts = 0
@@ -105,6 +113,7 @@ class RunStats:
         self.dispatch_giveups = 0
         self.checkpoint_rollbacks = 0
         self.last_rollback = None
+        self.train_batches_quarantined = 0
 
 
 run_stats = RunStats()
@@ -346,7 +355,8 @@ def fault_tolerance_summary() -> dict | None:
     return {k: v for k, v in snap.items()
             if k in ("restarts", "faults_injected", "rows_quarantined",
                      "dispatch_retries", "dispatch_giveups",
-                     "checkpoint_rollbacks", "last_rollback")
+                     "checkpoint_rollbacks", "last_rollback",
+                     "train_batches_quarantined")
             and v}
 
 

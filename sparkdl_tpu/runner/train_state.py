@@ -115,6 +115,20 @@ def make_train_step(loss_fn: Callable, mesh: Mesh, data_axis: str = "data",
     leading dim must divide by k (and by k x the data-axis size for even
     shards). Not supported with ``mutable`` (BatchNorm batch stats would
     silently become last-microbatch stats).
+
+    ``batch_spec`` overrides the default rows-over-``data_axis`` entry
+    layout (e.g. ``P("data", "sp")`` pins sequence sharding for the
+    DP×TP×SP composition). Caveat (advisor): the spec applies
+    **rank-truncated to EVERY batch leaf** — there is one spec, not a
+    per-leaf pytree. Under ``P("data", "sp")`` a 1-D ``[B]`` label leaf
+    constrains as ``P("data")`` (truncation does the right thing), but
+    ANY 2-D leaf gets its second dim sp-sharded, token dim or not: a
+    ``[B, K]`` float side-input (per-example weights, aux features) is
+    silently split over ``sp`` and XLA inserts a reshard at first
+    non-sequence use. Keep non-token >=2-D leaves out of the batch (or
+    feed them replicated outside it) when pinning a multi-axis spec; an
+    optional per-leaf spec pytree is the natural extension if that
+    becomes limiting.
     """
     if accum_steps > 1 and mutable:
         raise ValueError(

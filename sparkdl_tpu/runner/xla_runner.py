@@ -34,6 +34,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core import runtime
 from . import chaos
+from . import data as data_lib
 from . import events
 from . import metrics as metrics_lib
 from .checkpoint import CheckpointManager
@@ -212,11 +213,25 @@ class RunnerContext:
             flops_per_step: float | None = None) -> dict:
         """Run a full training loop; returns {state, meter, history}.
 
-        Streams ``data`` (iterator of host-numpy batch dicts), shards each
-        batch over the data axis, runs the compiled step, meters
-        examples/s/chip, checkpoints every ``checkpoint_every`` steps, and
-        resumes from the latest checkpoint when ``resume`` and one exists —
-        the checkpoint-and-restart failure-recovery story (SURVEY.md §5.3).
+        Streams ``data``, shards each batch over the data axis, runs the
+        compiled step, meters examples/s/chip, checkpoints every
+        ``checkpoint_every`` steps, and resumes from the latest checkpoint
+        when ``resume`` and one exists — the checkpoint-and-restart
+        failure-recovery story (SURVEY.md §5.3).
+
+        ``data`` may be a bare iterator of host-numpy batch dicts (the
+        original contract), or — for **exactly-once resume semantics** — a
+        :class:`~sparkdl_tpu.runner.data.CheckpointableDataset`, a list of
+        batches, or a generator *factory* (``data_lib.as_dataset``
+        coerces). With a dataset, the loop threads a data **cursor**: each
+        checkpoint manifest records the position after the last batch
+        consumed by a *completed* step, resume restores the dataset there
+        (a legacy manifest without a cursor records an
+        ``unverified_data_cursor`` degradation and starts the dataset from
+        its current position), the supervisor-grown skip-list
+        (``SPARKDL_SKIP_BATCHES``) is honored, and with
+        ``SPARKDL_BATCH_LEDGER`` set every completed step appends its
+        ``(step, epoch, batch_index)`` to a batch-id ledger.
 
         A tail batch skipped/cropped by ``accum_steps`` alignment does not
         consume a step slot: the loop draws a replacement batch, so it
@@ -229,13 +244,12 @@ class RunnerContext:
         thread for the wire time (the axon tunnel), the next batch's
         host→HBM transfer then overlaps the current step instead of
         serializing with it. Costs ``lookahead`` extra device batches of
-        HBM. Caveat: if the run raises mid-loop (step OOM, injected
-        failure), up to ``lookahead + 1`` prefetched batches have already
-        been drawn from ``data`` and are dropped with it — a caller that
-        reuses one iterator across fit() calls for exact resume semantics
-        on the ERROR path should keep the default inline feed (the
-        exactly-where-the-inline-feed-leaves-it guarantee holds only on
-        normal completion / StopIteration).
+        HBM. With a checkpointable dataset the lookahead is
+        resume-transparent: a mid-loop failure replays the prefetched
+        but unconsumed batches from the cursor on restart instead of
+        dropping them. Only a caller feeding a bare, reused iterator
+        still sees the old semantics (prefetched batches die with the
+        run) and should keep the inline feed for exact error-path resume.
 
         The loop is flight-recorded (``runner.events``): per-step
         ``data_fetch``/``shard_put``/``step_compute`` spans, checkpoint and
@@ -249,12 +263,32 @@ class RunnerContext:
         """
         state = TrainState.create(apply_fn or (lambda p, x: p), params, tx,
                                   model_state=model_state)
+        # Exactly-once data plane (ISSUE 5): replayable sources get a
+        # cursor threaded through checkpoints; bare iterators keep the
+        # legacy uncursored contract.
+        dataset = data_lib.as_dataset(data)
+        if dataset is not None:
+            dataset.extend_skip(data_lib.env_skip_list())
         start_step = 0
         if resume and self.checkpoints and \
                 self.checkpoints.latest_step() is not None:
             state = self.checkpoints.restore(state)
             start_step = int(state.step)
-            log.info("resumed from checkpoint at step %d", start_step)
+            cursor = None
+            if dataset is not None and start_step > 0:
+                # data_cursor() records the unverified_data_cursor
+                # degradation itself when the manifest carries none.
+                cursor = self.checkpoints.data_cursor(start_step)
+                if cursor is not None:
+                    dataset.restore(cursor)
+            # A resume is survived-failure narrative (the gang timeline's
+            # "restart-resume" degradation), never failure evidence.
+            events.event("train_resume", step=start_step,
+                         batch_index=(cursor or {}).get("batch_index"),
+                         epoch=(cursor or {}).get("epoch"),
+                         verified_cursor=cursor is not None)
+            log.info("resumed from checkpoint at step %d%s", start_step,
+                     f" (data cursor {cursor})" if cursor else "")
         # Replicate state over the mesh: fresh params arrive on one device
         # (and orbax restores there too); the sharded batch needs the state
         # addressable on every mesh device.
@@ -274,7 +308,14 @@ class RunnerContext:
         eval_step = self.make_eval_step(eval_fn) if eval_fn else None
         history: list[dict] = []
 
-        data_it = iter(data)
+        # Both paths feed (cursor_after | None, batch) pairs: the cursor
+        # rides WITH its batch through crop/lookahead staging, so whatever
+        # step ultimately consumes the batch knows exactly where the data
+        # plane stood after it — lookahead can run ahead freely.
+        if dataset is not None:
+            data_it = dataset.indexed()
+        else:
+            data_it = ((None, b) for b in iter(data))
 
         def _crop(batch):
             """accum tail-crop; None = skip this batch entirely."""
@@ -322,15 +363,16 @@ class RunnerContext:
                                       thread_name_prefix="sparkdl-shard")
 
         def _staged(limit: int):
-            """(local_rows, sharded_batch) stream: crop applied, at most
-            ``limit`` batches drawn from ``data_it`` — the lookahead may
-            never consume input the step loop won't run (a reused
-            iterator must sit exactly where the inline feed leaves it)."""
-            def _one(batch):
+            """(local_rows, sharded_batch, cursor_after) stream: crop
+            applied, at most ``limit`` batches drawn from ``data_it`` —
+            the lookahead may never consume input the step loop won't run
+            (a reused bare iterator must sit exactly where the inline
+            feed leaves it; a dataset replays from the cursor anyway)."""
+            def _one(cur, batch):
                 n = len(jax.tree_util.tree_leaves(batch)[0])
                 with events.span("shard_put"):
                     sharded = self.shard_batch(batch)
-                return (n, sharded)
+                return (n, sharded, cur)
 
             def _cropped():
                 """Draw-on-demand: nothing is pulled from data_it past
@@ -343,7 +385,7 @@ class RunnerContext:
                         # swallows it (PEP 479: it must not escape here).
                         with events.span("data_fetch",
                                          step=start_step + produced):
-                            batch = next(data_it)
+                            cur, batch = next(data_it)
                     except StopIteration:
                         return
                     batch = _crop(batch)
@@ -353,15 +395,15 @@ class RunnerContext:
                                        step=start_step + produced,
                                        batch=batch)
                     produced += 1
-                    yield batch
+                    yield cur, batch
 
             if pool is None:
-                for batch in _cropped():
-                    yield _one(batch)
+                for cur, batch in _cropped():
+                    yield _one(cur, batch)
                 return
             pending: collections.deque = collections.deque()
-            for batch in _cropped():
-                pending.append(pool.submit(_one, batch))
+            for cur, batch in _cropped():
+                pending.append(pool.submit(_one, cur, batch))
                 while len(pending) > lookahead:
                     yield pending.popleft().result()
             while pending:
@@ -373,12 +415,27 @@ class RunnerContext:
         last_m = None
         i = start_step
         failed = False
+        # Data-plane position of the step being processed / last
+        # completed: cur_cursor names the in-flight batch (postmortem
+        # attribution — the supervisor's poison-batch quarantine keys on
+        # it), last_cursor the one a completed step consumed (what the
+        # checkpoint manifest persists).
+        cur_cursor: dict | None = None
+        last_cursor: dict | None = None
         try:
             for i in range(start_step, num_steps):
+                # Cleared BEFORE anything this iteration can raise (the
+                # step_start chaos hook included): if staging or the hook
+                # raises, the postmortem must not inherit the PREVIOUS
+                # step's batch (the supervisor would quarantine an
+                # innocent batch and walk backwards through the dataset).
+                # Draw-time failures carry their own exact index via the
+                # dataset's exception tag instead.
+                cur_cursor = None
                 # Per-step fault-injection hook (no-op without a plan).
                 chaos.fire("step_start", step=i)
                 try:
-                    n_local, sharded = next(staged_it)
+                    n_local, sharded, cur_cursor = next(staged_it)
                 except StopIteration:
                     break
                 if estimate_flops:
@@ -408,6 +465,12 @@ class RunnerContext:
                 # the watchdog and then let a >watchdog_s compile read as
                 # a hang, deterministically burning the restart budget.
                 metrics_lib.touch_heartbeat(i)
+                # Step i consumed its batch: the cursor to persist, and a
+                # batch-id ledger line when SPARKDL_BATCH_LEDGER is set
+                # (the exactly-once audit trail across restart attempts).
+                if cur_cursor is not None:
+                    last_cursor = cur_cursor
+                    data_lib.append_ledger(i, cur_cursor)
                 # Host sync only at metering/logging boundaries; otherwise
                 # steps stay enqueued and transfers overlap compute.
                 last_m = m
@@ -428,7 +491,8 @@ class RunnerContext:
                     # would poison every subsequent resume (the host sync
                     # it costs rides the checkpoint's own sync cadence).
                     _assert_finite_loss(m, i + 1)
-                    self.checkpoints.save(i + 1, state)
+                    self.checkpoints.save(i + 1, state,
+                                          data_cursor=last_cursor)
                 if eval_step and eval_every and (i + 1) % eval_every == 0 \
                         and eval_data is not None:
                     with events.span("eval", step=i + 1):
@@ -441,8 +505,26 @@ class RunnerContext:
             # exception, flushed to SPARKDL_EVENT_DIR when set — the gang
             # supervisor merges these into its timeline. The marker keeps
             # outer handlers (run_with_restarts) from overwriting this
-            # step-bearing record with a step-less one.
-            events.postmortem(e, site="fit", step=i)
+            # step-bearing record with a step-less one. batch_index names
+            # the batch the failure is attributable to (ISSUE 5): two
+            # successive gang failures attributed to the same
+            # (step, batch_index) trigger the supervisor's poison-batch
+            # quarantine — so attribution must be exact or absent, never
+            # approximate (a wrong index quarantines good data). Draw-time
+            # failures carry the dataset's exception tag; in-step failures
+            # use the staged batch's cursor — EXCEPT a divergence detected
+            # at a log_every > 1 boundary, where the NaN-producing batch
+            # is anywhere in the window and naming the detection step's
+            # batch would be a guess.
+            bi = getattr(e, "_sparkdl_batch_index", None)
+            ep = getattr(e, "_sparkdl_batch_epoch", None)
+            if bi is None and cur_cursor is not None and not (
+                    isinstance(e, TrainingDivergedError)
+                    and log_every != 1):
+                bi = cur_cursor["batch_index"] - 1
+                ep = cur_cursor.get("epoch")
+            events.postmortem(e, site="fit", step=i,
+                              batch_index=bi, epoch=ep)
             e._sparkdl_postmortemed = True
             raise
         finally:
@@ -480,7 +562,8 @@ class RunnerContext:
             if self.checkpoints:
                 if last_m is not None:
                     _assert_finite_loss(last_m, int(state.step))
-                self.checkpoints.save(num_steps, state, wait=True)
+                self.checkpoints.save(num_steps, state, wait=True,
+                                      data_cursor=last_cursor)
         except BaseException as e:
             events.postmortem(e, site="fit_finalize", step=i)
             e._sparkdl_postmortemed = True

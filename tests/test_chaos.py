@@ -262,6 +262,33 @@ def test_chaos_smoke_script(tmp_path):
 
 
 @pytest.mark.slow
+@pytest.mark.chaos
+def test_train_resume_smoke_script(tmp_path):
+    """scripts/train_resume_smoke.py end-to-end (ISSUE 5 acceptance): a
+    supervised run with one injected SIGKILL and one deterministic poison
+    batch finishes; the batch-id ledger proves exactly-once consumption
+    (deterministic replay, only the quarantined batch skipped); the final
+    loss equals a clean run on the same skip-list; and the identical job
+    without the skip-list death-loops through its restart budget."""
+    import json
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(_REPO, "scripts", "train_resume_smoke.py")],
+        capture_output=True, text=True, timeout=420,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, \
+        f"stdout={proc.stdout[-2000:]}\nstderr={proc.stderr[-2000:]}"
+    rec = json.loads([ln for ln in proc.stdout.strip().splitlines()
+                      if ln.startswith("{")][-1])
+    assert rec["ok"] is True
+    assert rec["ledger_exactly_once"] is True
+    assert rec["ledger_replay_deterministic"] is True
+    assert rec["final_loss_matches_clean_run"] is True
+    assert rec["counterfactual_death_loops"] is True
+    assert rec["degradations_narrate_resume_and_quarantine"] is True
+
+
+@pytest.mark.slow
 def test_obs_smoke_script(tmp_path):
     """scripts/obs_smoke.py end-to-end (ISSUE 2 satellite): a real CPU fit
     under the supervisor with the flight recorder on and one injected
@@ -290,6 +317,39 @@ class TestCorruptKind:
         # env transport round-trips the new site/kind
         back = FaultPlan.from_env(FaultPlan([f]).to_env())
         assert back.faults == [f]
+
+    def test_poison_kind_and_data_fetch_site_validate(self):
+        """ISSUE 5: `poison` is a drawn-batch kind (data_fetch /
+        batch_fetch only); the data_fetch site's step is the dataset's
+        global batch index."""
+        with pytest.raises(ValueError, match="poison"):
+            Fault("step_start", "poison", prob=1.0)
+        f = Fault("data_fetch", "poison", at_step=8, once=False)
+        back = FaultPlan.from_env(FaultPlan([f]).to_env())
+        assert back.faults == [f]
+        assert Fault("batch_fetch", "poison", at_step=1).site == \
+            "batch_fetch"
+
+    def test_poison_nans_floats_or_raises_without_them(self):
+        import numpy as np
+        plan = chaos.install(FaultPlan(
+            [Fault("data_fetch", "poison", at_step=2, once=False)]))
+        try:
+            clean = {"x": np.ones(3, np.float32), "y": np.arange(3)}
+            out = plan.fire("data_fetch", step=1, batch=clean)
+            assert out is clean  # wrong batch index: untouched
+            out = plan.fire("data_fetch", step=2, batch=clean)
+            assert np.isnan(out["x"]).all()
+            np.testing.assert_array_equal(out["y"], np.arange(3))
+            # refires on the SAME index every time (once=False): the
+            # deterministic poison record the quarantine correlates on
+            out2 = plan.fire("data_fetch", step=2, batch=clean)
+            assert np.isnan(out2["x"]).all()
+            with pytest.raises(chaos.InjectedFatal, match="poison"):
+                plan.fire("data_fetch", step=2,
+                          batch={"ids": np.arange(3)})  # no float leaves
+        finally:
+            chaos.uninstall()
 
     def test_corrupt_damages_newest_step_only(self, tmp_path):
         for step, size in ((1, 64), (2, 64)):

@@ -174,6 +174,160 @@ class TestGangSupervision:
                       backoff_s=0.05, poll_s=0.25)
 
 
+# Jax-free poison worker: dies with a batch-attributed failure (postmortem
+# into SPARKDL_EVENT_DIR, the evidence the timeline correlates on) until
+# its poison batch lands on SPARKDL_SKIP_BATCHES, then exits 0. `mode`
+# picks the stderr/classification shape: retryable (UNAVAILABLE) or fatal
+# (TrainingDivergedError, the NaN-poison signature). `pick` chooses the
+# poison batch; "next_unskipped" models a systematically bad dataset
+# (a NEW poison appears whenever one is skipped) for the circuit breaker.
+_POISON_WORKER = """
+import json, os, sys, time
+skip = json.loads(os.environ.get("SPARKDL_SKIP_BATCHES", "[]"))
+mode = {mode!r}
+bi = {pick}
+if bi is None:
+    sys.exit(0)
+d = os.environ["SPARKDL_EVENT_DIR"]
+err = ({{"type": "TrainingDivergedError",
+        "message": "training diverged: non-finite loss (nan) at step %d" % bi}}
+       if mode == "fatal" else
+       {{"type": "InjectedPreemption", "message": "UNAVAILABLE: poison"}})
+pm = {{"t": time.time(), "rank": 0, "site": "fit", "step": bi,
+      "batch_index": bi, "error": err}}
+tmp = os.path.join(d, "postmortem_rank0.json.tmp")
+open(tmp, "w").write(json.dumps(pm))
+os.replace(tmp, os.path.join(d, "postmortem_rank0.json"))
+print(err["type"] + ": " + err["message"], file=sys.stderr)
+sys.exit(1)
+"""
+
+
+def _poison_script(tmp_path, mode="retryable",
+                   pick="8 if 8 not in skip else None"):
+    script = tmp_path / "poison.py"
+    script.write_text(_POISON_WORKER.format(mode=mode, pick=pick))
+    return str(script)
+
+
+class TestPoisonBatchQuarantine:
+    """ISSUE 5 tentpole, supervisor half: consecutive failures at one
+    (step, batch_index) quarantine the batch instead of burning the
+    restart budget; without the skip-list the same job death-loops (the
+    pre-ISSUE-5 counterfactual); SPARKDL_MAX_SKIPPED_BATCHES is the
+    circuit breaker. Jax-free workers — fast enough for tier-1; the
+    real-training end-to-end is scripts/train_resume_smoke.py (slow)."""
+
+    def test_retryable_poison_quarantined_after_two_failures(self, tmp_path):
+        from sparkdl_tpu.runner import metrics
+        metrics.run_stats.reset()
+        res = supervise(_poison_script(tmp_path), np=1, timeout_s=30.0,
+                        max_restarts=1, backoff_s=0.05, poll_s=0.2)
+        assert res.quarantined_batches == [8]
+        assert res.failure_kinds == ["retryable", "quarantined"]
+        assert res.restarts == 2  # one budgeted + one free quarantine
+        names = [d.get("name") for d in res.degradations]
+        assert "train_batch_quarantined" in names
+        q = next(d for d in res.degradations
+                 if d.get("name") == "train_batch_quarantined")
+        assert q["batch_index"] == 8 and q["skip_list"] == [8]
+        assert metrics.run_stats.train_batches_quarantined == 1
+        metrics.run_stats.reset()
+
+    def test_fatal_poison_gets_probe_restart_then_quarantine(self, tmp_path):
+        """A batch-attributed FATAL failure (TrainingDivergedError from a
+        NaN record) must not give up outright: one budgeted probe restart
+        tests determinism, recurrence quarantines."""
+        res = supervise(_poison_script(tmp_path, mode="fatal"), np=1,
+                        timeout_s=30.0, max_restarts=1, backoff_s=0.05,
+                        poll_s=0.2)
+        assert res.quarantined_batches == [8]
+        assert res.failure_kinds == ["fatal", "quarantined"]
+
+    def test_fatal_probe_not_blocked_by_earlier_unrelated_signature(
+            self, tmp_path):
+        """Review regression: a batch-attributed FATAL arriving after an
+        unrelated batch-attributed retryable failure must still get its
+        probe restart (the old gate required prev_sig to be None, so the
+        genuine poison gave up unprobed)."""
+        script = tmp_path / "w.py"
+        script.write_text("""
+import json, os, sys, time
+marker, skip = sys.argv[1], json.loads(
+    os.environ.get("SPARKDL_SKIP_BATCHES", "[]"))
+if not os.path.exists(marker):
+    # attempt 1: transient draw flake at batch 3, retryable-shaped
+    open(marker, "w").write("x")
+    bi, err = 3, {"type": "InjectedPreemption",
+                  "message": "UNAVAILABLE: transient flake"}
+elif 8 not in skip:
+    # attempts 2+: deterministic NaN poison at batch 8, fatal-shaped
+    bi, err = 8, {"type": "TrainingDivergedError",
+                  "message": "training diverged: non-finite loss (nan)"}
+else:
+    sys.exit(0)
+d = os.environ["SPARKDL_EVENT_DIR"]
+pm = {"t": time.time(), "rank": 0, "site": "fit", "step": bi,
+      "batch_index": bi, "error": err}
+tmp = os.path.join(d, "postmortem_rank0.json.tmp")
+open(tmp, "w").write(json.dumps(pm))
+os.replace(tmp, os.path.join(d, "postmortem_rank0.json"))
+print(err["type"] + ": " + err["message"], file=sys.stderr)
+sys.exit(1)
+""")
+        res = supervise(str(script), np=1, args=[str(tmp_path / "m")],
+                        timeout_s=30.0, max_restarts=3, backoff_s=0.05,
+                        poll_s=0.2)
+        assert res.quarantined_batches == [8]
+        assert res.failure_kinds == ["retryable", "fatal", "quarantined"]
+
+    def test_counterfactual_death_loop_without_quarantine(self, tmp_path):
+        """The pre-ISSUE-5 behavior, pinned: the identical poison job with
+        quarantine_batches=False replays into the same batch every
+        attempt and exhausts the restart budget."""
+        script = _poison_script(tmp_path, pick="8")  # never recovers
+        with pytest.raises(GangFailure, match="giving up after 2"):
+            supervise(script, np=1, timeout_s=30.0, max_restarts=2,
+                      backoff_s=0.05, poll_s=0.2,
+                      quarantine_batches=False)
+
+    def test_batchless_fatal_still_fails_fast(self, tmp_path):
+        """A fatal failure with NO batch attribution keeps today's
+        immediate give-up — the probe restart is only for failures the
+        quarantine could act on."""
+        script = tmp_path / "w.py"
+        script.write_text(
+            "import sys\nraise ValueError('user bug, no batch')\n")
+        with pytest.raises(GangFailure) as ei:
+            supervise(str(script), np=1, timeout_s=30.0, max_restarts=3,
+                      backoff_s=0.05, poll_s=0.2)
+        assert ei.value.kind == "fatal"
+        assert "giving up after 0 restart(s)" in str(ei.value)
+
+    def test_unskippable_poison_fails_fast_not_requarantine_loop(
+            self, tmp_path):
+        """Review regression: a poison the dataset CANNOT skip (draw-time
+        raise in a non-seekable source — the worker here keeps dying at
+        batch 8 even after it is skip-listed) must not alternate
+        quarantine/restart forever: one quarantine attempt, then the
+        normal budget policy, no duplicate skip-list entries."""
+        script = _poison_script(tmp_path, pick="8")  # ignores skip-list
+        with pytest.raises(GangFailure,
+                           match=r"giving up after 2 restart\(s\)"):
+            supervise(script, np=1, timeout_s=30.0, max_restarts=2,
+                      backoff_s=0.05, poll_s=0.2)
+
+    def test_max_skipped_batches_circuit_breaker(self, tmp_path):
+        """A dataset that presents a NEW poison batch whenever one is
+        skipped is systematically bad: past the cap the supervisor raises
+        fatal PoisonDataError instead of eating the dataset."""
+        from sparkdl_tpu.runner.failures import PoisonDataError
+        script = _poison_script(tmp_path, pick="len(skip)")
+        with pytest.raises(PoisonDataError, match="circuit breaker"):
+            supervise(script, np=1, timeout_s=30.0, max_restarts=8,
+                      backoff_s=0.05, poll_s=0.2, max_skipped_batches=2)
+
+
 @pytest.mark.slow
 @pytest.mark.chaos
 def test_supervise_sigkilled_rank_relaunches_to_completion(tmp_path):

@@ -413,6 +413,38 @@ class TestFSDP:
                                        rtol=5e-4, atol=5e-5)
 
 
+def test_fsdp_skips_indivisible_dims_with_mesh():
+    """Advisor (round 5) regression: with the mesh given, fsdp_rules must
+    only put the data axis on a dim divisible by mesh.shape[data_axis] —
+    a 50257-vocab embedding on data=4 splits unevenly and GSPMD would
+    pad-and-reshard it on every use. Later free dims are tried; with none
+    divisible the leaf falls back to the base spec."""
+    from sparkdl_tpu.parallel import fsdp_rules
+    mesh = runtime.make_mesh({"data": 4, "model": 2})
+    rules = transformer_tp_rules(data_axis="data", mesh=mesh)
+    params = {
+        # vocab 50257 % 4 != 0, hidden dim taken by TP -> base spec only
+        "embed_tokens": {"embedding": np.zeros((50257, 64))},
+        # first dim indivisible, SECOND free dim divisible -> data lands
+        # there (try-later-free-dims, not give-up-at-first)
+        "odd_head": {"kernel": np.zeros((7, 64))},
+        # the normal case keeps its FSDP sharding
+        "l0": {"q_proj": {"kernel": np.zeros((64, 64))}},
+    }
+    desc = describe(params, rules)
+    assert desc["embed_tokens/embedding"] == str(P(None, "model"))
+    assert desc["odd_head/kernel"] == str(P(None, "data"))
+    assert desc["l0/q_proj/kernel"] == str(P("data", "model"))
+    # documented limitation: WITHOUT the mesh the extent is unknown and
+    # the first free dim is taken unchecked (pre-fix behavior)
+    no_mesh = describe(params, transformer_tp_rules(data_axis="data"))
+    assert no_mesh["embed_tokens/embedding"] == str(P("data", "model"))
+    # bare fsdp_rules (no TP base) honors the mesh too
+    bare = fsdp_rules(data_axis="data", mesh=mesh)
+    assert describe({"t": {"kernel": np.zeros((50257, 7))}},
+                    bare)["t/kernel"] == str(P())
+
+
 def test_fsdp_lora_and_idempotence():
     """lora_rules composes over the FSDP wrapper (adapters inherit the
     BASE TP layout, deliberately unsharded on data), and re-applying
