@@ -443,6 +443,12 @@ def _worker_featurizer() -> dict:
         if e.get("ph") == "E" and "dur_s" in e:
             stage_seconds[e["name"]] = round(
                 stage_seconds.get(e["name"], 0.0) + e["dur_s"], 4)
+    # Bottleneck evidence per revision (ISSUE 6 satellite): overlap-aware
+    # busy fractions + the dominant stage, next to the raw stage_seconds
+    # sums — BENCH_* files then say WHICH stage bounds the rate, not just
+    # how the seconds added up across concurrent workers.
+    from sparkdl_tpu.runner import analysis as analysis_lib
+    stage_utilization = analysis_lib.utilization_from_events(rec.tail())
     events_lib.reset()
 
     # A/B: same transform with 4 concurrent transfer threads
@@ -556,6 +562,7 @@ def _worker_featurizer() -> dict:
             "native_packer": native_mod.available(),
             "decode_workers": decode_workers_default(),
             "stage_seconds": stage_seconds,
+            "stage_utilization": stage_utilization,
             "breakdown": {k: round(v, 3) if isinstance(v, float) else v
                           for k, v in breakdown.items()}}
 
@@ -1379,7 +1386,10 @@ def main():
         extra["inference"] = {
             "rows_per_sec": round(feat["rows_per_sec"], 2),
             "decode_workers": feat.get("decode_workers"),
-            "stage_seconds": feat.get("stage_seconds", {})}
+            "stage_seconds": feat.get("stage_seconds", {}),
+            # ISSUE 6: per-stage busy fractions + dominant stage, so the
+            # per-revision record carries bottleneck attribution.
+            "stage_utilization": feat.get("stage_utilization")}
     elif feat_err:
         extra["featurizer_error"] = feat_err
     if bert:

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Bottleneck attribution report over flight-recorder span streams and
+telemetry snapshots (ISSUE 6, layer 3).
+
+Consumes the per-rank ``events_rank{i}.jsonl`` streams a run left under
+``SPARKDL_EVENT_DIR`` (supervised gangs stream one level down in
+``gang-*/`` subdirs — picked up automatically) and prints a per-stage
+utilization table: busy seconds, wall-busy fraction, exclusive time,
+achieved parallelism, rows and bytes moved — then names the dominant
+stage with the Amdahl-style projection ("decode 94% busy → ≤1.06x from
+fixing anything else"). With ``--metrics-dir`` it also prints the
+gang-level aggregate of the live telemetry snapshots
+(``metrics_rank{i}.json``, written by ``SPARKDL_METRICS_DIR`` runs).
+
+Usage:
+    python scripts/bottleneck_report.py EVENT_DIR [--metrics-dir DIR]
+        [--json]
+
+Exit codes: 0 = report printed; 2 = no span evidence found.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# analysis/telemetry are stdlib-only; the package import pulls jax into
+# the interpreter (inert — no device query, so no backend init: the same
+# rule the supervising launcher rides).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from sparkdl_tpu.runner import analysis, telemetry  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Per-stage utilization + bottleneck attribution from "
+                    "flight-recorder span streams")
+    ap.add_argument("event_dir",
+                    help="directory of events_rank*.jsonl streams "
+                         "(SPARKDL_EVENT_DIR; gang-*/ subdirs included)")
+    ap.add_argument("--metrics-dir", default=None,
+                    help="directory of metrics_rank*.json telemetry "
+                         "snapshots (SPARKDL_METRICS_DIR) to aggregate "
+                         "alongside")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one machine-readable JSON object instead "
+                         "of the table")
+    ns = ap.parse_args(argv)
+
+    rep = analysis.analyze(event_dir=ns.event_dir)
+    agg = telemetry.aggregate_snapshots(ns.metrics_dir) \
+        if ns.metrics_dir else None
+    if rep is None and agg is None:
+        print(f"bottleneck_report: no span streams or snapshots under "
+              f"{ns.event_dir}"
+              + (f" / {ns.metrics_dir}" if ns.metrics_dir else ""),
+              file=sys.stderr)
+        return 2
+
+    if ns.json:
+        print(json.dumps({"report": rep, "gang_metrics": agg},
+                         default=str))
+        return 0
+    if rep is not None:
+        print(analysis.format_report(rep))
+    if agg is not None:
+        print(f"\ngang telemetry ({agg['n_ranks']} rank(s), elapsed "
+              f"{agg['elapsed_s']:.3f}s):")
+        for name, st in sorted(agg["stages"].items(),
+                               key=lambda kv: -kv[1]["busy_frac"]):
+            print(f"  {name}: busy {st['busy_s']:.3f}s "
+                  f"({100 * st['busy_frac']:.1f}% of gang rank-time), "
+                  f"rows {st['rows']}, "
+                  f"max_concurrency {st['max_concurrency']}")
+        for name, n in sorted((agg.get("events") or {}).items()):
+            print(f"  event {name}: {n}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

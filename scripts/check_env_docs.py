@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Doc-drift lint: every ``SPARKDL_*`` env var referenced by the package
+must be documented in the README (ISSUE 6 satellite).
+
+PRs 1–5 grew ~30 ``SPARKDL_*`` knobs; each is one rename (or one new
+knob) away from silently drifting out of the README's env-var tables.
+This lint greps ``sparkdl_tpu/`` (plus ``bench.py`` and ``scripts/``)
+for the pattern and fails loudly when any var is missing from
+``README.md``. Stdlib-only, no imports of the package — it must run in
+any environment, fast, as a tier-1 test (``tests/test_telemetry.py``)
+and standalone in CI:
+
+    python scripts/check_env_docs.py          # exit 1 + list on drift
+"""
+
+import os
+import re
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_VAR_RE = re.compile(r"SPARKDL_[A-Z0-9_]+")
+# Trailing fragments the regex over-matches in prose/format strings
+# (e.g. "SPARKDL_FLASH_BLOCK_Q``/``_K" documents _K via ellipsis) are
+# NOT special-cased: every var must appear verbatim in the README.
+
+
+def code_env_vars(root: str = _REPO) -> set[str]:
+    """Every SPARKDL_* name referenced by package/bench/scripts code."""
+    out: set[str] = set()
+    roots = [os.path.join(root, "sparkdl_tpu"),
+             os.path.join(root, "scripts"),
+             os.path.join(root, "bench.py")]
+    for top in roots:
+        if os.path.isfile(top):
+            files = [top]
+        else:
+            files = []
+            for dirpath, dirnames, filenames in os.walk(top):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__"]
+                files += [os.path.join(dirpath, f) for f in filenames
+                          if f.endswith(".py")]
+        for path in files:
+            try:
+                with open(path, encoding="utf-8", errors="replace") as f:
+                    out.update(_VAR_RE.findall(f.read()))
+            except OSError:
+                continue
+    return out
+
+
+def documented_env_vars(readme: str | None = None) -> set[str]:
+    readme = readme or os.path.join(_REPO, "README.md")
+    try:
+        with open(readme, encoding="utf-8", errors="replace") as f:
+            return set(_VAR_RE.findall(f.read()))
+    except OSError:
+        return set()
+
+
+def missing_vars(root: str = _REPO, readme: str | None = None) -> list[str]:
+    """Vars referenced in code but absent from the README, sorted."""
+    return sorted(code_env_vars(root) - documented_env_vars(readme))
+
+
+def main() -> int:
+    missing = missing_vars()
+    if missing:
+        print("check_env_docs: SPARKDL_* env vars referenced in code but "
+              "missing from README.md:", file=sys.stderr)
+        for v in missing:
+            print(f"  {v}", file=sys.stderr)
+        print("Document each in the README env-var tables (Observability "
+              "/ Batch scoring pipeline / Environment variables).",
+              file=sys.stderr)
+        return 1
+    n = len(code_env_vars())
+    print(f"check_env_docs: ok — {n} SPARKDL_* vars all documented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,16 +1,25 @@
 #!/usr/bin/env python
-"""Observability smoke: flight recorder + gang-timeline postmortem,
-end-to-end through the supervising launcher, on CPU (ISSUE 2 satellite).
+"""Observability smoke: flight recorder + gang-timeline postmortem +
+live telemetry plane, end-to-end on CPU (ISSUE 2 + ISSUE 6 satellites).
 
-Flow: ``supervise(max_restarts=0)`` launches a single-rank training worker
-with the flight recorder armed (``SPARKDL_EVENT_DIR`` is injected by the
-supervisor) and a ``FaultPlan`` that raises an UNAVAILABLE-shaped preemption
-at step 3. The worker dies; ``fit()``'s failure path flushes a crash
-postmortem; the supervisor merges the rank's event stream, postmortem, and
-heartbeat into ``gang_timeline.json`` and raises a :class:`GangFailure`
-carrying it. This script asserts the merged postmortem names the faulted
-rank, its last step, and the chaos site, then prints one JSON line and
-exits 0.
+Leg 1 (postmortem): ``supervise(max_restarts=0)`` launches a single-rank
+training worker with the flight recorder armed (``SPARKDL_EVENT_DIR`` is
+injected by the supervisor) and a ``FaultPlan`` that raises an
+UNAVAILABLE-shaped preemption at step 3. The worker dies; ``fit()``'s
+failure path flushes a crash postmortem; the supervisor merges the rank's
+event stream, postmortem, and heartbeat into ``gang_timeline.json`` and
+raises a :class:`GangFailure` carrying it. Asserts the merged postmortem
+names the faulted rank, its last step, and the chaos site.
+
+Leg 2 (live telemetry, ISSUE 6): drives a small streamed-scoring run
+(deliberately decode-bound) with ``SPARKDL_METRICS_DIR`` armed, asserts a
+live per-rank snapshot file appears MID-run (before the stream
+finishes), then runs ``scripts/bottleneck_report.py`` over the span
+streams + snapshots and asserts it names ``decode`` — the expected
+host-side stage — as the bottleneck with internally consistent busy
+fractions.
+
+Prints one JSON line; exits 0 iff both legs held.
 
 Run: ``JAX_PLATFORMS=cpu python scripts/obs_smoke.py``
 """
@@ -56,6 +65,92 @@ runner.run(lambda ctx: ctx.fit(
 """
 
 
+def _scoring_leg(out_dir: str) -> dict:
+    """ISSUE 6: streamed scoring with the telemetry plane armed from the
+    environment, live-snapshot-mid-run assertion, bottleneck report.
+    Imports jax — runs AFTER the supervise leg (whose process must stay
+    backend-free until its workers own the chips)."""
+    import subprocess
+    import time
+
+    metrics_dir = os.path.join(out_dir, "metrics")
+    event_dir = os.path.join(out_dir, "score_events")
+    os.environ["SPARKDL_METRICS_DIR"] = metrics_dir
+    os.environ["SPARKDL_METRICS_INTERVAL_S"] = "0.05"
+    os.environ["SPARKDL_EVENT_DIR"] = event_dir
+    try:
+        import numpy as np
+        import pyarrow as pa
+
+        from sparkdl_tpu.core.runtime import BatchRunner
+        from sparkdl_tpu.transformers.streaming import StreamScorer
+
+        n_chunks, rows = 40, 4
+
+        def make_decoder(rb):
+            def decode(start, length):
+                time.sleep(0.02)  # decode-bound by construction: the
+                return np.full((length, 3), float(start), np.float32)
+            return decode          # report must name this stage
+
+        scorer = StreamScorer(
+            BatchRunner(lambda b: b * 2.0, batch_size=rows), "y",
+            make_decoder=make_decoder,
+            encode=lambda r: pa.array([float(v) for v in r[:, 0]],
+                                      type=pa.float64()),
+            empty_array=lambda: pa.array([], type=pa.float64()),
+            chunk_rows=rows, decode_workers=2)
+        batches = [pa.RecordBatch.from_arrays(
+            [pa.array([float(i)] * rows)], ["x"]) for i in range(n_chunks)]
+        snap_path = os.path.join(metrics_dir, "metrics_rank0.json")
+        first_seen_at = None
+        n_out = 0
+        for _ in scorer(iter(batches)):
+            n_out += 1
+            if first_seen_at is None and os.path.exists(snap_path):
+                first_seen_at = n_out  # live snapshot, mid-run
+        from sparkdl_tpu.runner import telemetry
+        telemetry.stop()  # final flush so the report sees exact books
+
+        report = {}
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(_REPO, "scripts", "bottleneck_report.py"),
+             event_dir, "--metrics-dir", metrics_dir, "--json"],
+            capture_output=True, text=True, timeout=120)
+        if proc.returncode == 0:
+            for line in reversed(proc.stdout.strip().splitlines()):
+                if line.startswith("{"):
+                    report = json.loads(line)
+                    break
+        rep = report.get("report") or {}
+        stages = rep.get("stages") or {}
+        fracs_consistent = bool(stages) and all(
+            0.0 <= st.get("busy_frac", -1) <= 1.0 for st in stages.values())
+        return {
+            "scored_rows": n_out * rows,
+            "snapshot_mid_run": first_seen_at is not None
+            and first_seen_at < n_chunks,
+            "snapshot_first_seen_at_batch": first_seen_at,
+            "report_rc": proc.returncode,
+            "dominant_stage": rep.get("dominant_stage"),
+            "dominant_busy_frac": rep.get("dominant_busy_frac"),
+            "max_speedup_fixing_others":
+                rep.get("max_speedup_fixing_others"),
+            "busy_fracs_consistent": fracs_consistent,
+            "gang_metrics_ranks":
+                (report.get("gang_metrics") or {}).get("n_ranks"),
+            "ok": first_seen_at is not None and first_seen_at < n_chunks
+            and n_out == n_chunks
+            and rep.get("dominant_stage") == "decode"
+            and fracs_consistent,
+        }
+    finally:
+        for v in ("SPARKDL_METRICS_DIR", "SPARKDL_METRICS_INTERVAL_S",
+                  "SPARKDL_EVENT_DIR"):
+            os.environ.pop(v, None)
+
+
 def main() -> int:
     out_dir = tempfile.mkdtemp(prefix="sparkdl-obs-smoke-")
     event_dir = os.path.join(out_dir, "events")
@@ -79,22 +174,26 @@ def main() -> int:
         with open(merged_path) as f:
             on_disk = json.load(f)
     ff = (tl or {}).get("first_failure") or {}
-    ok = (err is not None
-          and tl is not None
-          and tl.get("first_failing_rank") == 0
-          and ff.get("site") == "step_start"
-          and ff.get("step") == 3
-          and (tl["ranks"].get("0") or {}).get("last_step") == 3
-          and on_disk.get("first_failing_rank") == 0
-          and "UNAVAILABLE" in str(err))
+    postmortem_ok = (err is not None
+                     and tl is not None
+                     and tl.get("first_failing_rank") == 0
+                     and ff.get("site") == "step_start"
+                     and ff.get("step") == 3
+                     and (tl["ranks"].get("0") or {}).get("last_step") == 3
+                     and on_disk.get("first_failing_rank") == 0
+                     and "UNAVAILABLE" in str(err))
+    telemetry = _scoring_leg(out_dir)
+    ok = postmortem_ok and telemetry["ok"]
     print(json.dumps({
         "ok": ok,
+        "postmortem_ok": postmortem_ok,
         "first_failing_rank": tl.get("first_failing_rank") if tl else None,
         "fault_site": ff.get("site"),
         "fault_step": ff.get("step"),
         "last_step": (tl["ranks"].get("0") or {}).get("last_step")
         if tl else None,
         "gang_timeline": merged_path,
+        "telemetry": telemetry,
         "out_dir": out_dir,
     }))
     return 0 if ok else 1

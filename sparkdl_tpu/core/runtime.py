@@ -59,6 +59,13 @@ def _run_stats():
     return metrics.run_stats
 
 
+def _telemetry():
+    """Live telemetry plane (ISSUE 6), lazily — stdlib-only module, same
+    sys.modules-hit-after-first pattern as _events()."""
+    from sparkdl_tpu.runner import telemetry
+    return telemetry
+
+
 def devices() -> list:
     return jax.devices()
 
@@ -533,6 +540,17 @@ class BatchRunner:
         """
         ev = _events()
         chaos = _chaos()
+        tel = _telemetry()
+        # Env-armed live telemetry (ISSUE 6): with SPARKDL_METRICS_DIR /
+        # SPARKDL_METRICS_PORT unset this is two dict lookups and the
+        # plane stays off — the accountant tees off the spans below only
+        # when armed. Gauges are fetched once per stream, set per batch.
+        tel.maybe_start_from_env()
+        depth_gauge = occupancy_gauge = None
+        if tel.enabled():
+            depth_gauge = tel.registry().gauge("run_stream_window_depth")
+            occupancy_gauge = tel.registry().gauge(
+                "run_stream_slot_occupancy")
         retries = dispatch_retries_default()
         backoff_s = dispatch_backoff_default()
         stall_s = dispatch_timeout_default()
@@ -553,7 +571,12 @@ class BatchRunner:
             # The padded host batch is kept only while retries are
             # enabled: it is what the re-dispatch path re-puts.
             padded, n, meta, idx = slot
-            with ev.span("put"):
+            # rows/bytes on the put span: host→HBM traffic is the
+            # telemetry plane's bytes-moved ledger (the PCIe/wire story
+            # ROADMAP item 2 is chasing); nbytes is attr reads, not math.
+            nbytes = sum(getattr(leaf, "nbytes", 0)
+                         for leaf in jax.tree_util.tree_leaves(padded))
+            with ev.span("put", rows=n, bytes=nbytes):
                 return put(padded), (padded if retries else None), n, \
                     meta, idx
 
@@ -672,9 +695,22 @@ class BatchRunner:
             except Exception as e:  # noqa: BLE001 — reclassified
                 out = retry_or_raise("dispatch", e, host, n, idx, state)
             window.append((out, host, n, meta, idx, state))
-            if len(window) > self.prefetch:
-                yield fetch(window.popleft())
+            oldest = window.popleft() if len(window) > self.prefetch \
+                else None
+            if depth_gauge is not None:
+                # Live in-flight view: window depth + slot occupancy
+                # (fraction of the prefetch capacity holding a dispatched
+                # execution) — a persistently sub-1 occupancy means the
+                # feed, not the device, is the bottleneck. Read AFTER the
+                # pop: a keeping-up feed reads 1.0, not a perpetual
+                # (prefetch+1)/prefetch.
+                depth_gauge.set(len(window))
+                occupancy_gauge.set(len(window) / max(self.prefetch, 1))
+            if oldest is not None:
+                yield fetch(oldest)
         while window:
+            if depth_gauge is not None:
+                depth_gauge.set(len(window))
             yield fetch(window.popleft())
 
 

@@ -11,7 +11,9 @@ per-rank events with crash postmortems and merged gang timelines, plus
 step-time percentiles and MFU in ``ThroughputMeter.summary()``.
 """
 
+from . import analysis
 from . import events
+from . import telemetry
 from .chaos import Fault, FaultPlan, InjectedFatal, InjectedFault, \
     InjectedPreemption
 from .checkpoint import CheckpointCorruptionError, CheckpointManager, \
@@ -28,6 +30,12 @@ from .launcher import GangFailure, SuperviseResult, launch, supervise
 from .metrics import MetricsLogger, StepTimeStats, ThroughputMeter, \
     debug_mode, global_step_stats, peak_flops_per_chip, run_stats, \
     touch_heartbeat, trace
+# Live telemetry plane (ISSUE 6): arm/disarm + the gang aggregation the
+# supervisor uses; `enable_telemetry` is the public one-call switch next
+# to enable_flight_recorder.
+from .telemetry import MetricsRegistry, StageAccountant, \
+    aggregate_snapshots, render_prometheus
+from .telemetry import start as enable_telemetry
 from .train_state import (TrainState, bn_classifier_loss, make_eval_step,
                           make_shard_map_step, make_train_step,
                           softmax_cross_entropy_loss, state_sharding)
@@ -57,4 +65,6 @@ __all__ = [
     "events", "FlightRecorder", "Timer", "enable_flight_recorder",
     "merge_timeline", "exception_summary",
     "StepTimeStats", "global_step_stats", "peak_flops_per_chip",
+    "telemetry", "analysis", "enable_telemetry", "MetricsRegistry",
+    "StageAccountant", "aggregate_snapshots", "render_prometheus",
 ]

@@ -1,0 +1,270 @@
+"""Bottleneck attribution over span streams and telemetry snapshots
+(ISSUE 6, layer 3).
+
+The streamed scorer delivers 82–287 img/s against a 2541 img/s device
+roofline (ROADMAP item 2); the spans from PR 3 can prove exactly *where*
+the wall time goes, but until now proving it meant hand-jq'ing raw JSONL.
+This module turns a span stream — the flight recorder's ring tail, a rank's
+``events_rank{i}.jsonl``, or a whole event dir — into a per-stage
+utilization breakdown:
+
+- **busy_s** — summed span durations (slot-seconds; two pool workers busy
+  one wall second contribute 2.0);
+- **wall_busy_s** — the union of the stage's active intervals (wall
+  seconds during which >= 1 span of the stage was open);
+- **busy_frac** — wall-busy over the stream's elapsed wall: the
+  bottleneck signal, in [0, 1] by construction;
+- **exclusive_s** — wall seconds during which ONLY this stage was active
+  (a timeline sweep across all stages): the Amdahl-relevant quantity —
+  eliminating the stage entirely saves at most its exclusive time;
+- **idle_s** — wall seconds where *no* stage was active (gaps the spans
+  do not explain: GC, scheduling, untraced work).
+
+Attribution names the **dominant stage** (highest busy fraction) and the
+Amdahl-style projection: with the dominant stage wall-busy fraction f,
+perfecting everything else yields at most **1/f** speedup ("decode pool
+94% busy → ≤1.06x from fixing anything else") — so effort goes where the
+time actually is. Stdlib-only; ``scripts/bottleneck_report.py`` is the
+CLI over it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Iterable
+
+__all__ = ["intervals_from_events", "read_span_stream", "load_event_dir",
+           "union_seconds", "analyze", "utilization_from_events",
+           "format_report"]
+
+_EVENT_FILE_RE = re.compile(r"events_rank(\d+)\.jsonl$")
+# Span names that are not pipeline *stages*: whole-run envelopes whose
+# duration would swamp every real stage's busy fraction.
+_NON_STAGE_SPANS = frozenset({"eval"})
+
+
+def read_span_stream(path: str) -> list[dict]:
+    """All records of one ``events_rank*.jsonl`` file (full read — this is
+    the offline analysis tool, not the supervisor's bounded tail)."""
+    recs = []
+    with open(path, "rb") as f:
+        for line in f:
+            try:
+                recs.append(json.loads(line))
+            except ValueError:
+                continue  # torn tail line from a killed rank
+    return recs
+
+
+def load_event_dir(event_dir: str) -> list[dict]:
+    """Every rank's span stream under ``event_dir``, merged — plus the
+    NEWEST non-empty ``gang-*/`` subdir supervised gangs stream into.
+    Newest only, the same rule as ``telemetry.aggregate_snapshots``: a
+    reused SPARKDL_EVENT_DIR accumulates one kept gang-* subdir per
+    supervise() run, and merging unrelated runs into one timeline would
+    turn the gap between them into fictitious idle time and collapse
+    every busy fraction."""
+    recs: list[dict] = []
+    try:
+        names = sorted(os.listdir(event_dir))
+    except OSError:
+        return recs
+    for fn in names:
+        if _EVENT_FILE_RE.match(fn):
+            try:
+                recs.extend(read_span_stream(os.path.join(event_dir, fn)))
+            except OSError:
+                continue
+    gang_dirs = [os.path.join(event_dir, fn) for fn in names
+                 if fn.startswith("gang-")
+                 and os.path.isdir(os.path.join(event_dir, fn))]
+    try:
+        gang_dirs.sort(key=os.path.getmtime, reverse=True)
+    except OSError:
+        pass
+    for gd in gang_dirs:
+        gang_recs = load_event_dir(gd)
+        if gang_recs:
+            recs.extend(gang_recs)
+            break
+    return recs
+
+
+def intervals_from_events(events: Iterable[dict]) -> dict[str, list]:
+    """stage → [(t0, t1, rows, bytes), ...] from span END records (the E
+    event carries ``t`` and ``dur_s``, so t0 = t - dur_s; B events are
+    not needed and a stream truncated mid-span degrades gracefully)."""
+    out: dict[str, list] = {}
+    for r in events:
+        if r.get("ph") != "E":
+            continue
+        dur = r.get("dur_s")
+        name = r.get("name")
+        if not isinstance(name, str) or name in _NON_STAGE_SPANS \
+                or not isinstance(dur, (int, float)) or dur < 0:
+            continue
+        t1 = r.get("t")
+        if not isinstance(t1, (int, float)):
+            continue
+        out.setdefault(name, []).append(
+            (t1 - dur, t1, int(r.get("rows") or 0),
+             int(r.get("bytes") or 0)))
+    return out
+
+
+def union_seconds(intervals: list) -> float:
+    """Total length of the union of (t0, t1, ...) intervals."""
+    if not intervals:
+        return 0.0
+    ivs = sorted((iv[0], iv[1]) for iv in intervals)
+    total = 0.0
+    cur0, cur1 = ivs[0]
+    for t0, t1 in ivs[1:]:
+        if t0 > cur1:
+            total += cur1 - cur0
+            cur0, cur1 = t0, t1
+        else:
+            cur1 = max(cur1, t1)
+    return total + (cur1 - cur0)
+
+
+def _sweep(per_stage: dict[str, list]) -> tuple[dict[str, float], float]:
+    """Timeline sweep over all stages' intervals → (exclusive seconds per
+    stage, idle seconds). A slice of wall time is *exclusive* to a stage
+    when that stage alone is active; *idle* when none is."""
+    points: list[tuple[float, int, str]] = []
+    for name, ivs in per_stage.items():
+        for iv in ivs:
+            points.append((iv[0], +1, name))
+            points.append((iv[1], -1, name))
+    if not points:
+        return {}, 0.0
+    points.sort(key=lambda p: (p[0], -p[1]))  # opens before closes at ties
+    active: dict[str, int] = {}
+    exclusive = {name: 0.0 for name in per_stage}
+    idle = 0.0
+    prev_t = points[0][0]
+    for t, delta, name in points:
+        dt = t - prev_t
+        if dt > 0:
+            live = [s for s, n in active.items() if n > 0]
+            if len(live) == 1:
+                exclusive[live[0]] += dt
+            elif not live:
+                idle += dt
+        prev_t = t
+        active[name] = active.get(name, 0) + delta
+    return exclusive, idle
+
+
+def analyze(events: Iterable[dict] | None = None,
+            event_dir: str | None = None) -> dict | None:
+    """Per-stage utilization breakdown + bottleneck attribution.
+
+    Pass raw records (``events``) or a directory of per-rank streams
+    (``event_dir``). Returns None when no spans are found. The report is
+    internally consistent by construction: every ``busy_frac`` is a
+    clamped interval-union over the measured wall, exclusive+overlap
+    never exceeds wall, and ``idle_s`` is what the spans leave
+    unexplained.
+    """
+    if events is None:
+        events = load_event_dir(event_dir) if event_dir else []
+    events = list(events)
+    per_stage = intervals_from_events(events)
+    if not per_stage:
+        return None
+    t_begin = min(iv[0] for ivs in per_stage.values() for iv in ivs)
+    t_end = max(iv[1] for ivs in per_stage.values() for iv in ivs)
+    wall = max(t_end - t_begin, 1e-9)
+    exclusive, idle = _sweep(per_stage)
+    stages = {}
+    for name, ivs in sorted(per_stage.items()):
+        busy = sum(iv[1] - iv[0] for iv in ivs)
+        wall_busy = min(union_seconds(ivs), wall)
+        excl = min(exclusive.get(name, 0.0), wall_busy)
+        stages[name] = {
+            "count": len(ivs),
+            "busy_s": round(busy, 6),
+            "wall_busy_s": round(wall_busy, 6),
+            "busy_frac": round(min(1.0, wall_busy / wall), 4),
+            "exclusive_s": round(excl, 6),
+            "exclusive_frac": round(min(1.0, excl / wall), 4),
+            "avg_concurrency": round(busy / wall_busy, 2)
+            if wall_busy > 0 else 0.0,
+            "rows": sum(iv[2] for iv in ivs),
+            "bytes": sum(iv[3] for iv in ivs),
+        }
+        if stages[name]["rows"] and wall > 0:
+            stages[name]["rows_per_sec"] = round(
+                stages[name]["rows"] / wall, 2)
+    dominant = max(stages, key=lambda s: stages[s]["busy_frac"])
+    dom_frac = stages[dominant]["busy_frac"]
+    # Amdahl bound: the dominant stage stays on the critical path for its
+    # wall-busy seconds however fast everything else gets — perfecting
+    # the rest yields at most wall / wall_busy_dominant.
+    max_speedup_others = round(1.0 / dom_frac, 2) if dom_frac > 0 else None
+    # And per the dominant stage itself: removing only ITS exclusive time
+    # (the overlapped part is hidden behind other stages already).
+    dom_excl = stages[dominant]["exclusive_s"]
+    dom_speedup = round(wall / max(wall - dom_excl, 1e-9), 2)
+    return {
+        "wall_s": round(wall, 6),
+        "idle_s": round(idle, 6),
+        "idle_frac": round(min(1.0, idle / wall), 4),
+        "stages": stages,
+        "dominant_stage": dominant,
+        "dominant_busy_frac": dom_frac,
+        "max_speedup_fixing_others": max_speedup_others,
+        "max_speedup_fixing_dominant": dom_speedup,
+    }
+
+
+def utilization_from_events(events: Iterable[dict]) -> dict | None:
+    """Compact ``stage_utilization`` block for bench records: the analyze
+    report minus the per-stage exclusive sweep detail."""
+    rep = analyze(events=events)
+    if rep is None:
+        return None
+    return {
+        "wall_s": rep["wall_s"],
+        "idle_frac": rep["idle_frac"],
+        "dominant_stage": rep["dominant_stage"],
+        "max_speedup_fixing_others": rep["max_speedup_fixing_others"],
+        "stages": {name: {k: st[k] for k in
+                          ("busy_s", "busy_frac", "avg_concurrency",
+                           "count", "rows")}
+                   for name, st in rep["stages"].items()},
+    }
+
+
+def format_report(rep: dict) -> str:
+    """Human rendering: one aligned row per stage, attribution last."""
+    cols = ("stage", "n", "busy_s", "busy%", "excl_s", "avg_par", "rows",
+            "MB")
+    rows = []
+    for name, st in sorted(rep["stages"].items(),
+                           key=lambda kv: -kv[1]["busy_frac"]):
+        rows.append((
+            name, str(st["count"]), f"{st['busy_s']:.3f}",
+            f"{100 * st['busy_frac']:.1f}", f"{st['exclusive_s']:.3f}",
+            f"{st['avg_concurrency']:.2f}", str(st["rows"]),
+            f"{st['bytes'] / 1e6:.1f}"))
+    widths = [max(len(c), *(len(r[i]) for r in rows))
+              for i, c in enumerate(cols)]
+    lines = ["  ".join(c.ljust(widths[i]) for i, c in enumerate(cols))]
+    lines += ["  ".join(v.ljust(widths[i]) for i, v in enumerate(r))
+              for r in rows]
+    lines.append(
+        f"wall {rep['wall_s']:.3f}s, idle (no stage active) "
+        f"{rep['idle_s']:.3f}s ({100 * rep['idle_frac']:.1f}%)")
+    dom = rep["dominant_stage"]
+    lines.append(
+        f"dominant stage: {dom} "
+        f"({100 * rep['dominant_busy_frac']:.1f}% busy) — fixing anything "
+        f"else yields <= {rep['max_speedup_fixing_others']}x; eliminating "
+        f"{dom}'s exclusive time yields <= "
+        f"{rep['max_speedup_fixing_dominant']}x")
+    return "\n".join(lines)

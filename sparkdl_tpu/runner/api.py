@@ -15,7 +15,10 @@ import jax.numpy as jnp
 
 # Public observability surface (ISSUE 2): `runner.api.enable_flight_recorder`
 # next to the hvd shims — migrated scripts get tracing with one call.
+# ISSUE 6 adds its live twin: `enable_telemetry(metrics_dir=..., port=...)`
+# arms the stage accountant + snapshot exporter + Prometheus endpoint.
 from .events import enable_flight_recorder  # noqa: F401
+from .telemetry import start as enable_telemetry  # noqa: F401
 from .xla_runner import RunnerContext, XlaRunner, current_context
 
 _default_runner: XlaRunner | None = None

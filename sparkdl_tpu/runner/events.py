@@ -42,7 +42,7 @@ __all__ = ["FlightRecorder", "Timer", "RECORDER_DIR_ENV", "RING_ENV",
            "event", "span", "postmortem", "get_recorder", "reset",
            "enable_flight_recorder", "merge_timeline", "format_timeline",
            "write_gang_postmortem", "clear_rank_files",
-           "collect_degradations"]
+           "collect_degradations", "add_tee", "remove_tee"]
 
 log = logging.getLogger("sparkdl_tpu.runner")
 
@@ -59,6 +59,29 @@ def _rank() -> int:
         return int(os.environ.get("SPARKDL_PROCESS_ID", "0"))
     except ValueError:
         return 0
+
+
+# Event tees (ISSUE 6): consumers that see every emitted record in-process
+# — the telemetry plane's StageAccountant rides here, turning span exits
+# into per-stage busy-seconds without touching any instrumentation site.
+# Module-level (not per-recorder) so a tests' events.reset() cannot
+# silently detach a live accountant. Empty by default: the hot-path cost
+# of an unused tee list is one falsy check per emit.
+_TEES: list = []
+
+
+def add_tee(cb) -> None:
+    """Register ``cb(record_dict)`` to observe every emitted event.
+    Idempotent per callable."""
+    if cb not in _TEES:
+        _TEES.append(cb)
+
+
+def remove_tee(cb) -> None:
+    try:
+        _TEES.remove(cb)
+    except ValueError:
+        pass
 
 
 class Timer:
@@ -185,6 +208,13 @@ class FlightRecorder:
         if attrs:
             rec.update(attrs)
         self.ring.append(rec)
+        if _TEES:
+            for cb in _TEES:
+                try:
+                    cb(rec)
+                except Exception:  # noqa: BLE001 — telemetry must never
+                    pass  # kill the hot path, nor one broken tee starve
+                    # the others of the event (per-callback isolation)
         d = os.environ.get(RECORDER_DIR_ENV)
         if d:
             self._write(d, rec)

@@ -72,6 +72,8 @@ import time
 
 from . import events as events_lib
 from . import failures
+# telemetry is stdlib-only (ISSUE 6): safe in the jax-free supervisor.
+from . import telemetry as telemetry_lib
 from .chaos import FaultPlan
 # data is jax-free (stdlib + lazy numpy): safe in the supervisor process.
 from .data import SKIP_ENV, env_skip_list
@@ -123,6 +125,11 @@ class SuperviseResult:
     # Poison batches appended to the dataset skip-list across restarts
     # (ISSUE 5): global batch indices the final attempt trained WITHOUT.
     quarantined_batches: list = dataclasses.field(default_factory=list)
+    # Gang-level telemetry view (ISSUE 6): the per-rank live snapshots
+    # under SPARKDL_METRICS_DIR aggregated at completion
+    # (telemetry.aggregate_snapshots) — per-stage busy-seconds/rows/bytes
+    # summed across ranks. None when no rank exported metrics.
+    metrics: dict | None = None
 
     @property
     def last_failure_kind(self) -> str | None:
@@ -445,7 +452,50 @@ def _prune_empty_gang_dir(adopted_dir: str | None):
         pass
 
 
-def _gang_timeline(event_dir: str | None, heartbeat_dir: str | None):
+def _gang_metrics(metrics_dir: str | None) -> dict | None:
+    """Aggregate the ranks' live telemetry snapshots (never raises — a
+    telemetry assembly bug must not replace the primary outcome)."""
+    if not metrics_dir:
+        return None
+    try:
+        return telemetry_lib.aggregate_snapshots(metrics_dir)
+    except Exception:
+        log.warning("gang metrics aggregation failed", exc_info=True)
+        return None
+
+
+def _metrics_dir_from(env: dict | None) -> str | None:
+    """The metrics dir the workers will export into: the caller's env=
+    dict wins over the supervisor's inherited environment (same
+    resolution order _spawn_gang's penv merge produces)."""
+    return (env or {}).get(telemetry_lib.METRICS_DIR_ENV) or \
+        os.environ.get(telemetry_lib.METRICS_DIR_ENV)
+
+
+def _adopt_gang_metrics_dir(env: dict) -> str | None:
+    """Give the gang a fresh ``gang-*`` snapshot subdir under the
+    inherited metrics dir and point the workers' exporters at it
+    (mutates ``env``). The inherited dir may hold a previous run's
+    ``metrics_rank*.json`` — including higher ranks from a larger
+    earlier gang — or the DRIVER's own live exporter snapshot;
+    aggregating those as this gang's books would misattribute stages.
+    Returns the adopted subdir, or None when no metrics dir is armed
+    (or it cannot be created — telemetry degrades, never kills the
+    launch)."""
+    metrics_dir = _metrics_dir_from(env)
+    if not metrics_dir:
+        return None
+    try:
+        os.makedirs(metrics_dir, exist_ok=True)
+        adopted = tempfile.mkdtemp(prefix="gang-", dir=metrics_dir)
+        env[telemetry_lib.METRICS_DIR_ENV] = adopted
+        return adopted
+    except OSError:
+        return None
+
+
+def _gang_timeline(event_dir: str | None, heartbeat_dir: str | None,
+                   metrics_dir: str | None = None):
     """Merge the ranks' flight-recorder traces into the gang timeline.
     Returns (timeline_dict | None, message_suffix). Never raises — a
     postmortem assembly bug must not replace the primary failure."""
@@ -460,6 +510,12 @@ def _gang_timeline(event_dir: str | None, heartbeat_dir: str | None):
         if not any(d.get("n_events") or d.get("postmortem")
                    for d in tl["ranks"].values()):
             return None, ""
+        # Fold the gang's final telemetry view into the timeline (ISSUE
+        # 6): the postmortem then shows which stage was starving when the
+        # gang died, next to who died first.
+        gm = _gang_metrics(metrics_dir)
+        if gm is not None:
+            tl["metrics"] = gm
         path = events_lib.write_gang_postmortem(event_dir, tl)
         return tl, "\n" + events_lib.format_timeline(tl) + \
             f"\n(merged gang timeline: {path})"
@@ -470,12 +526,14 @@ def _gang_timeline(event_dir: str | None, heartbeat_dir: str | None):
 
 def _failure(status: str, results, info, timeout_s: float, capture: bool,
              event_dir: str | None = None,
-             heartbeat_dir: str | None = None) -> GangFailure:
+             heartbeat_dir: str | None = None,
+             metrics_dir: str | None = None) -> GangFailure:
     """Build the GangFailure for a non-ok attempt: message carries the
     postmortem (which ranks died/stalled + salvaged stderr + the merged
     gang timeline when the workers streamed events), ``kind`` carries the
     restart-policy verdict."""
-    timeline, tl_msg = _gang_timeline(event_dir, heartbeat_dir)
+    timeline, tl_msg = _gang_timeline(event_dir, heartbeat_dir,
+                                      metrics_dir=metrics_dir)
     if status == "failed":
         ranks = info["ranks"]
         first = ranks[0]
@@ -556,19 +614,27 @@ def launch(script: str, np: int = 2, args: list[str] | None = None,
         event_dir = adopted_dir = _gang_event_subdir(env)
     if event_dir:
         os.makedirs(event_dir, exist_ok=True)
+    # Same metrics-dir isolation as supervise() (see
+    # _adopt_gang_metrics_dir): a reused dir's stale rank books must not
+    # become THIS gang's failure evidence.
+    env = dict(env or {})
+    metrics_dir = adopted_metrics_dir = _adopt_gang_metrics_dir(env)
     status, results, info = _run_gang(
         script, np, args, env, timeout_s, coordinator, capture, poll_s,
         heartbeat_dir, watchdog_s, event_dir=event_dir)
     if status == "ok":
         _prune_empty_gang_dir(adopted_dir)
+        _prune_empty_gang_dir(adopted_metrics_dir)
         return results
     err = _failure(status, results, info, timeout_s, capture,
-                   event_dir=event_dir, heartbeat_dir=heartbeat_dir)
+                   event_dir=event_dir, heartbeat_dir=heartbeat_dir,
+                   metrics_dir=metrics_dir)
     # Workers wrote no traces (jax-free scripts): drop the empty adopted
     # subdir. rmdir-only-when-empty, NOT rmtree keyed on err.timeline —
     # timeline assembly can fail with real evidence on disk, and that
     # evidence must survive.
     _prune_empty_gang_dir(adopted_dir)
+    _prune_empty_gang_dir(adopted_metrics_dir)
     raise err
 
 
@@ -677,8 +743,17 @@ def supervise(script: str, np: int = 2, args: list[str] | None = None,
     restarts = 0      # every relaunch, for the recovery ledger
     budget_used = 0   # failure-driven relaunches, checked against budget
     kinds: list[str] = []
+    # Live telemetry (ISSUE 6): when the workers will export snapshots
+    # (SPARKDL_METRICS_DIR in env= or the environment), the supervisor
+    # aggregates them into the gang-level view at completion — and
+    # clears attempt N-1's files first, same staleness rule as traces.
+    # The gang gets its own subdir (see _adopt_gang_metrics_dir); kept
+    # on completion when non-empty, like gang event dirs.
+    metrics_dir = adopted_metrics_dir = _adopt_gang_metrics_dir(env)
     while True:
         # (_run_gang clears attempt N-1's heartbeats/traces before spawning)
+        if metrics_dir:
+            telemetry_lib.clear_rank_files(metrics_dir)
         status, results, info = _run_gang(
             script, np, args, env, timeout_s, None, capture, poll_s,
             heartbeat_dir, watchdog_s, event_dir=event_dir)
@@ -699,16 +774,23 @@ def supervise(script: str, np: int = 2, args: list[str] | None = None,
                     "supervise: gang succeeded after surviving %d "
                     "degradation event(s): %s", len(degradations),
                     sorted({d.get("name") for d in degradations}))
+            # Gang-level telemetry BEFORE cleanup: the final attempt's
+            # per-rank snapshots merge into one stage-utilization view
+            # (ISSUE 6) riding the result next to the degradations.
+            gang_metrics = _gang_metrics(metrics_dir)
             for d in tmp_dirs:  # kept on failure paths for postmortems
                 shutil.rmtree(d, ignore_errors=True)
             _prune_empty_gang_dir(adopted_dir)
+            _prune_empty_gang_dir(adopted_metrics_dir)
             return SuperviseResult(results=results, restarts=restarts,
                                    attempts=restarts + 1,
                                    failure_kinds=kinds,
                                    degradations=degradations,
-                                   quarantined_batches=list(quarantined))
+                                   quarantined_batches=list(quarantined),
+                                   metrics=gang_metrics)
         err = _failure(status, results, info, timeout_s, capture,
-                       event_dir=event_dir, heartbeat_dir=heartbeat_dir)
+                       event_dir=event_dir, heartbeat_dir=heartbeat_dir,
+                       metrics_dir=metrics_dir)
         sig = _batch_signature(err) if quarantine_batches else None
         # Correlate on the BATCH INDEX: the signature's step component is
         # reported but not compared — evidence sources disagree on it (a
@@ -742,6 +824,7 @@ def supervise(script: str, np: int = 2, args: list[str] | None = None,
             step_, batch_index = sig
             if len(quarantined) >= max_skipped_batches:
                 _prune_empty_gang_dir(adopted_dir)
+                _prune_empty_gang_dir(adopted_metrics_dir)
                 raise PoisonDataError(quarantined, max_skipped_batches,
                                       last_failure=str(err)[:300]) from err
             quarantined.append(batch_index)
@@ -807,6 +890,7 @@ def supervise(script: str, np: int = 2, args: list[str] | None = None,
             # just clutter in the user's telemetry dir (rmdir-only-when-
             # empty — real traces always survive the give-up path).
             _prune_empty_gang_dir(adopted_dir)
+            _prune_empty_gang_dir(adopted_metrics_dir)
             raise err
         prev_sig = sig
         restarts += 1

@@ -20,6 +20,7 @@ from dataclasses import dataclass, field
 import jax
 
 from . import events
+from . import telemetry
 
 log = logging.getLogger("sparkdl_tpu.runner")
 
@@ -341,6 +342,10 @@ class ThroughputMeter:
             "mfu": round(mfu, 4) if mfu is not None else None,
             "compile_cache": compile_cache_summary(),
             "fault_tolerance": fault_tolerance_summary(),
+            # Live telemetry plane (ISSUE 6): per-stage busy fractions +
+            # the dominant stage, from the armed accountant. None when
+            # the plane is off — clean summaries stay clean.
+            "stage_utilization": telemetry.stage_utilization_summary(),
         }
 
 
@@ -420,15 +425,24 @@ class MetricsLogger:
         log.info("step %d %s", step, json.dumps(flat, default=str))
 
     def log_summary(self, step: int, summary: dict):
-        """Flatten a ``meter.summary()`` (nested ``step_time`` block) into
-        scalars and emit once — percentiles and MFU land in TB/text next
-        to the per-step series."""
+        """Flatten a ``meter.summary()`` into scalars and emit once —
+        percentiles, MFU, and the nested subsystem blocks
+        (``fault_tolerance``, ``compile_cache``, ``stage_utilization``)
+        land in TB/text next to the per-step series. Flattening is
+        RECURSIVE (ISSUE 6 satellite): a doubly-nested block like
+        ``compile_cache.persistent.hits`` becomes the scalar key
+        ``compile_cache_persistent_hits`` instead of a stringified dict
+        that TB silently drops and CSV consumers can't parse."""
         flat: dict = {}
-        for k, v in summary.items():
+
+        def _flatten(prefix: str, v):
             if isinstance(v, dict):
-                flat.update({f"{k}_{k2}": v2 for k2, v2 in v.items()})
+                for k2, v2 in v.items():
+                    _flatten(f"{prefix}_{k2}" if prefix else str(k2), v2)
             elif v is not None:
-                flat[k] = v
+                flat[prefix] = v
+
+        _flatten("", summary)
         self.log(step, flat)
 
     def close(self):

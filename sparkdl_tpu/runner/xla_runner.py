@@ -37,6 +37,7 @@ from . import chaos
 from . import data as data_lib
 from . import events
 from . import metrics as metrics_lib
+from . import telemetry as telemetry_lib
 from .checkpoint import CheckpointManager
 from .failures import TrainingDivergedError
 from .train_state import (TrainState, make_eval_step, make_shard_map_step,
@@ -303,6 +304,9 @@ class RunnerContext:
         estimate_flops = (flops_per_step is None
                           and _env_flag("SPARKDL_MFU_ESTIMATE"))
         logger = metrics_lib.MetricsLogger(self.log_dir)
+        # Live telemetry plane (ISSUE 6): env-armed, ≈ free when
+        # SPARKDL_METRICS_DIR/PORT are unset (two dict lookups).
+        telemetry_lib.maybe_start_from_env()
         events.event("fit_start", start_step=start_step,
                      num_steps=num_steps, n_chips=self.size)
         eval_step = self.make_eval_step(eval_fn) if eval_fn else None
@@ -369,8 +373,12 @@ class RunnerContext:
             (a reused bare iterator must sit exactly where the inline
             feed leaves it; a dataset replays from the cursor anyway)."""
             def _one(cur, batch):
-                n = len(jax.tree_util.tree_leaves(batch)[0])
-                with events.span("shard_put"):
+                leaves = jax.tree_util.tree_leaves(batch)
+                n = len(leaves[0])
+                # rows/bytes ride the span so the stage accountant's
+                # bytes-moved ledger covers the training feed too.
+                nbytes = sum(getattr(x, "nbytes", 0) for x in leaves)
+                with events.span("shard_put", rows=n, bytes=nbytes):
                     sharded = self.shard_batch(batch)
                 return (n, sharded, cur)
 
@@ -525,6 +533,10 @@ class RunnerContext:
                 ep = cur_cursor.get("epoch")
             events.postmortem(e, site="fit", step=i,
                               batch_index=bi, epoch=ep)
+            # The dying rank's last telemetry snapshot is failure
+            # evidence too (which stage was starving when the gang died)
+            # — flush it next to the postmortem. No-op when disarmed.
+            telemetry_lib.flush_snapshot()
             e._sparkdl_postmortemed = True
             raise
         finally:
@@ -575,6 +587,9 @@ class RunnerContext:
         logger.log_summary(num_steps, summary)
         events.event("fit_end", final_step=num_steps,
                      steps=meter.steps, mfu=summary.get("mfu"))
+        # Exact-at-the-boundary snapshot (not one export interval stale):
+        # the supervisor's gang aggregation reads this file.
+        telemetry_lib.flush_snapshot()
         logger.close()
         return {"state": state, "meter": meter, "history": history}
 

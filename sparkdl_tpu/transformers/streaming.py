@@ -54,7 +54,7 @@ import pyarrow as pa
 
 from ..core.frame import _set_column
 from ..core.runtime import (BatchRunner, _chaos, _events, _failures,
-                            _run_stats, parallel_map_iter)
+                            _run_stats, _telemetry, parallel_map_iter)
 
 ERROR_CLASS_COL = "error_class"
 ERROR_COL = "error"
@@ -282,6 +282,16 @@ class StreamScorer:
                  ) -> Iterator[pa.RecordBatch]:
         from concurrent.futures import ThreadPoolExecutor
         ev = _events()
+        tel = _telemetry()
+        tel.maybe_start_from_env()
+        pending_gauge = backlog_gauge = None
+        if tel.enabled():
+            # Live queue-depth gauges (ISSUE 6): `pending` = partitions
+            # whose chunks are still in flight (reassembly latency),
+            # `backlog` = fetched-but-unencoded raw outputs parked on the
+            # overlap worker (encode falling behind the device).
+            pending_gauge = tel.registry().gauge("scorer_pending_partitions")
+            backlog_gauge = tel.registry().gauge("scorer_encode_backlog")
         # Entries appear here in partition order as the chunk producer
         # (pulled on this thread through the decode pool / put window)
         # walks the input; each holds its RecordBatch and expected chunk
@@ -376,6 +386,9 @@ class StreamScorer:
                 entry["futs"].append(fut)
                 while pending and complete(pending[0]):
                     yield self._finish(pending.popleft(), run_sink)
+                if pending_gauge is not None:
+                    pending_gauge.set(len(pending))
+                    backlog_gauge.set(len(backlog))
             # End of stream: the breaker now knows the TRUE whole-input
             # bad fraction — evaluate it with no sample-size floor.
             breaker_check(1)

@@ -120,6 +120,11 @@ def test_all_metric_legs_run_end_to_end_tiny_cpu():
     assert extra["inference"]["rows_per_sec"] > 0
     assert {"decode", "dispatch", "fetch", "encode"} <= \
         set(extra["inference"]["stage_seconds"]), extra["inference"]
+    # bottleneck evidence per revision (ISSUE 6): overlap-aware busy
+    # fractions + the named dominant stage ride next to stage_seconds
+    su = extra["inference"]["stage_utilization"]
+    assert su and su["dominant_stage"] in su["stages"], extra["inference"]
+    assert all(0.0 <= s["busy_frac"] <= 1.0 for s in su["stages"].values())
     assert "gen_eos_error" not in extra
     # mid-stream EOS exit: the loop iterated, then stopped early
     assert 0 < extra["gen_eos_steps"] < extra["gen_new_tokens"], extra

@@ -290,16 +290,27 @@ def test_train_resume_smoke_script(tmp_path):
 
 @pytest.mark.slow
 def test_obs_smoke_script(tmp_path):
-    """scripts/obs_smoke.py end-to-end (ISSUE 2 satellite): a real CPU fit
-    under the supervisor with the flight recorder on and one injected
-    preemption; the merged gang-timeline postmortem must name the faulted
-    rank and site."""
+    """scripts/obs_smoke.py end-to-end (ISSUE 2 + ISSUE 6 satellites): a
+    real CPU fit under the supervisor with the flight recorder on and one
+    injected preemption — the merged gang-timeline postmortem must name
+    the faulted rank and site; then a streamed-scoring run with the live
+    telemetry plane armed — a snapshot file must appear MID-run and the
+    bottleneck report must name the expected host-side stage (decode)
+    with internally consistent busy fractions."""
     proc = subprocess.run(
         [sys.executable, os.path.join(_REPO, "scripts", "obs_smoke.py")],
         capture_output=True, text=True, timeout=420,
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert proc.returncode == 0, proc.stderr[-2000:]
-    assert '"ok": true' in proc.stdout, proc.stdout[-2000:]
+    rec = json.loads([ln for ln in proc.stdout.strip().splitlines()
+                      if ln.startswith("{")][-1])
+    assert rec["ok"] is True
+    assert rec["postmortem_ok"] is True
+    tele = rec["telemetry"]
+    assert tele["snapshot_mid_run"] is True
+    assert tele["dominant_stage"] == "decode"
+    assert tele["busy_fracs_consistent"] is True
+    assert tele["max_speedup_fixing_others"] >= 1.0
 
 
 class TestCorruptKind:
