@@ -1074,7 +1074,27 @@ def _worker_generate() -> dict:
     return rec
 
 
+def _worker_host_ingest() -> dict:
+    """Backend-free host-ingest rate (ISSUE 7): decode→pack→stage rows/s
+    against a STUB device (``scripts/ingest_bench.py``). No jax, no
+    backend — this leg measures the host side of the scoring feed and
+    records even when the TPU probe fails, so ``BENCH_*`` carries a real
+    trajectory number through ``backend_unavailable`` stretches. The
+    record embeds the pre-ISSUE-7 feed (``legs.f32_host``) next to the
+    new default (``legs.u8_fused``) — before/after on the same workload."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "ingest_bench", os.path.join(_HERE, "scripts", "ingest_bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    # Default NOT divisible by the 64-row bench batch: the tail chunk is
+    # what exercises the StagingPool (see scripts/ingest_bench.py).
+    rows = int(os.environ.get("BENCH_INGEST_ROWS", "1000"))
+    return mod.run(rows=rows)
+
+
 _WORKERS = {"resnet50_train": _worker_resnet50_train,
+            "host_ingest": _worker_host_ingest,
             "featurizer": _worker_featurizer,
             "bert_train": _worker_bert_train,
             "flash": _worker_flash,
@@ -1323,10 +1343,24 @@ def main():
     if probe:
         extra["backend"] = probe
     else:
-        err_extra = {"probe_error": probe_err,
-                     "budget": {"wall_s": budget.wall_s,
-                                "spent_s": round(budget.spent(), 1),
-                                "leg_times_s": dict(budget.leg_times)}}
+        err_extra = {"probe_error": probe_err}
+        # The backend is down, but the HOST is not: the jax-free ingest
+        # leg still measures (ISSUE 7) so the record is never blind on
+        # the host-side trajectory during an outage. Same skip knob as
+        # the healthy-backend path.
+        if os.environ.get("BENCH_SKIP_INGEST"):
+            ingest_rec, ingest_err = None, {"kind": "skipped",
+                                            "detail": "env"}
+        else:
+            ingest_rec, ingest_err = _run_worker("host_ingest",
+                                                 probe_timeout, 0, budget)
+        if ingest_rec:
+            err_extra["host_ingest"] = ingest_rec
+        elif ingest_err:
+            err_extra["host_ingest_error"] = ingest_err
+        err_extra["budget"] = {"wall_s": budget.wall_s,
+                               "spent_s": round(budget.spent(), 1),
+                               "leg_times_s": dict(budget.leg_times)}
         # An outage at bench time must not erase the round's measured
         # evidence: embed the newest on-chip record + the probe history.
         pl = _probe_log_summary()
@@ -1362,6 +1396,9 @@ def main():
     # flash runs before bert/gen: it is the cheapest leg and carries the
     # compiled-kernel evidence — if the budget runs dry, lose a throughput
     # number, not the proof.
+    # host-ingest first: cheapest leg, jax-free, and the ISSUE 7
+    # before/after evidence — never starved by the heavy legs.
+    ingest_rec, ingest_err = leg("host_ingest", "BENCH_SKIP_INGEST")
     feat, feat_err = leg("featurizer", "BENCH_SKIP_FEATURIZER")
     flash, flash_err = leg("flash", "BENCH_SKIP_FLASH")
     bert, bert_err = leg("bert_train", "BENCH_SKIP_BERT")
@@ -1375,6 +1412,10 @@ def main():
     if train:
         extra.update({k: round(v, 6) if isinstance(v, float) else v
                       for k, v in train.items() if k != "img_s_chip"})
+    if ingest_rec:
+        extra["host_ingest"] = ingest_rec
+    elif ingest_err:
+        extra["host_ingest_error"] = ingest_err
     if feat:
         extra["featurizer_rows_per_sec"] = round(feat["rows_per_sec"], 2)
         extra["featurizer_config"] = {

@@ -1,0 +1,229 @@
+#!/usr/bin/env python
+"""Host-ingest microbench: decode→pack→stage rows/s with a STUB device.
+
+The scoring path is host-bound (ROADMAP item 2: ~81 f32 / ~287 u8 img/s
+against a 2541 img/s device roofline) and the host stages — Arrow decode,
+pack/resize, pad, stage — are exactly the code ISSUE 7 rewrote. This
+bench measures THOSE stages alone, with no device in the loop: the
+"device" is a stub that only keeps the wire-byte ledger, so the number
+is a pure host-ingest rate that runs (and lands in ``BENCH_*``) even
+when the TPU probe reports ``backend_unavailable``, and re-verifies
+unchanged on hardware later.
+
+Legs (each: synthetic uniform uint8 image column → chunk → decode pool →
+stage → stub put):
+
+- ``f32_host``   — the PRE-ISSUE-7 feed: host resize+BGR→RGB+cast to
+  float32 at the model size, per-batch pad allocation, thread decode.
+- ``u8_fused``   — the post-ISSUE-7 default: ``imageColumnFeed`` ships
+  the zero-copy storage-dtype view at native size (device would do
+  flip/cast/resize inside the jitted program), staged through the
+  reused ``StagingPool``.
+- ``f32_process`` (``--process``) — the f32 host feed on the process
+  decode pool: what ``SPARKDL_DECODE_BACKEND=process`` buys when decode
+  is GIL-bound (the pure-python pack fallback; with the native packer
+  installed decode releases the GIL and threads already scale).
+
+Output (``--json``): per-leg ``rows_per_sec`` + ``wire_bytes_per_row`` +
+staging stats, plus ``deltas`` (f32_host → u8_fused speedup and wire-byte
+ratio) — the before/after evidence the bench record embeds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pyarrow as pa
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_column(rows: int, h: int, w: int, seed: int = 0) -> pa.Array:
+    """Uniform uint8 BGR image-struct column, the scorer's wire format."""
+    from sparkdl_tpu.image import imageIO
+    rng = np.random.default_rng(seed)
+    # One base image + per-row roll: cheap to build, incompressible enough.
+    base = rng.integers(0, 256, (h, w, 3), np.uint8)
+    structs = [imageIO.imageArrayToStruct(np.roll(base, i, axis=0),
+                                          origin=f"mem://{i}")
+               for i in range(rows)]
+    return pa.array(structs, type=imageIO.imageSchema)
+
+
+def run_leg(col: pa.Array, *, fused: bool, staging: bool, batch_size: int,
+            target: tuple[int, int], workers: int = 2,
+            backend: str = "thread", min_seconds: float = 0.0) -> dict:
+    """Decode→stage passes over ``col`` (repeated until ``min_seconds``
+    of wall time so fast legs aren't timer noise); returns the record."""
+    from sparkdl_tpu.core import ingest
+    from sparkdl_tpu.image import imageIO
+    th, tw = target
+    n = len(col)
+    chunks = [(s, min(batch_size, n - s)) for s in range(0, n, batch_size)]
+    pool = ingest.StagingPool() if staging else None
+
+    def decoded_stream():
+        if backend == "process":
+            ex = ingest.get_decode_executor(workers)
+
+            def tasks():
+                # picklable tasks, exactly as the scorer ships them: the
+                # module-level factory + a COMPACTED chunk slice
+                for s, length in chunks:
+                    compact = pa.concat_arrays([col.slice(s, length)])
+                    payload = (compact, th, tw, "RGB", "float32", fused,
+                               True)
+                    yield (ingest.decode_image_chunk, payload, length,
+                           False, None)
+
+            return (arr for arr, _info, _dur in ingest.windowed_apply(
+                ingest.run_decode_task, tasks(), workers, workers,
+                executor=ex))
+
+        def decode(chunk):
+            s, length = chunk
+            return imageIO.imageColumnFeed(
+                col.slice(s, length), th, tw, dtype=np.float32,
+                channelOrder="RGB", fused=fused)
+
+        # THE runtime window (ingest.windowed_apply) — the bench measures
+        # the exact pipeline the scorer runs, not a stand-in.
+        return ingest.windowed_apply(decode, chunks, workers, workers)
+
+    def one_pass() -> tuple[int, int]:
+        wire_bytes = rows = 0
+        in_flight = []  # lease window of 2 — mimics the put/fetch overlap
+        for arr in decoded_stream():
+            if pool is not None:
+                staged, nv, lease, _copied = ingest.stage_batch(
+                    arr, batch_size, pool)
+            else:
+                # pre-ISSUE-7 pad: fresh concatenate per short batch
+                nv = arr.shape[0]
+                if nv < batch_size:
+                    pad = np.broadcast_to(
+                        arr[:1], (batch_size - nv,) + arr.shape[1:])
+                    staged = np.concatenate([arr, pad], axis=0)
+                else:
+                    staged = arr
+                lease = None
+            # STUB device put: the ledger reads what device_put WOULD ship.
+            wire_bytes += staged.nbytes
+            rows += nv
+            in_flight.append(lease)
+            if len(in_flight) > 2:  # "fetch" completed → recyclable
+                done = in_flight.pop(0)
+                if pool is not None:
+                    pool.release(done)
+        # Drain the window: leaked leases would read as fresh allocs on
+        # the next pass and under-report reuse across the min_seconds loop.
+        while in_flight:
+            done = in_flight.pop(0)
+            if pool is not None:
+                pool.release(done)
+        return rows, wire_bytes
+
+    rows = wire_bytes = passes = 0
+    t0 = time.perf_counter()
+    while True:
+        r, b = one_pass()
+        rows += r
+        wire_bytes += b
+        passes += 1
+        if time.perf_counter() - t0 >= min_seconds:
+            break
+    dt = time.perf_counter() - t0
+    return {
+        "rows": rows, "passes": passes,
+        "rows_per_sec": round(rows / dt, 2) if dt else 0.0,
+        "seconds": round(dt, 4),
+        "wire_bytes_per_row": int(wire_bytes / max(rows, 1)),
+        "fused": fused, "staging": staging, "backend": backend,
+        "workers": workers,
+        "staging_stats": pool.stats() if pool is not None else None,
+    }
+
+
+def run(rows: int = 1000, stored: int = 112, target: int = 224,
+        batch_size: int = 64, workers: int = 2,
+        with_process: bool = False) -> dict:
+    """All legs over one shared column; returns the full record."""
+    col = build_column(rows, stored, stored)
+    legs = {}
+    # warmup decode machinery (imports, native packer) outside the bracket
+    run_leg(col.slice(0, min(batch_size * 2, rows)), fused=False,
+            staging=False, batch_size=batch_size, target=(target, target),
+            workers=workers)
+    legs["f32_host"] = run_leg(
+        col, fused=False, staging=False, batch_size=batch_size,
+        target=(target, target), workers=workers, min_seconds=0.5)
+    legs["u8_fused"] = run_leg(
+        col, fused=True, staging=True, batch_size=batch_size,
+        target=(target, target), workers=workers, min_seconds=0.5)
+    if with_process:
+        legs["f32_process"] = run_leg(
+            col, fused=False, staging=False, batch_size=batch_size,
+            target=(target, target), workers=workers, backend="process",
+            min_seconds=0.5)
+    f32, u8 = legs["f32_host"], legs["u8_fused"]
+    return {
+        "metric": "host_ingest_rows_per_sec",
+        "value": u8["rows_per_sec"],
+        "unit": "rows/s",
+        "config": {"rows": rows, "stored": stored, "target": target,
+                   "batch_size": batch_size, "decode_workers": workers},
+        "legs": legs,
+        "deltas": {
+            # before/after on the same workload: the ISSUE 7 acceptance
+            # evidence (>=2x rows/s on the f32 image path, >=4x fewer
+            # wire bytes on the u8 path).
+            "rows_per_sec_vs_f32_host": round(
+                u8["rows_per_sec"] / f32["rows_per_sec"], 2)
+            if f32["rows_per_sec"] else None,
+            "wire_bytes_ratio_f32_over_u8": round(
+                f32["wire_bytes_per_row"] / u8["wire_bytes_per_row"], 2)
+            if u8["wire_bytes_per_row"] else None,
+        },
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    # 1000 deliberately NOT divisible by the batch size: the short tail
+    # chunk each pass is what drives stage_batch through the StagingPool
+    # (an all-full-batch config would pass through and prove nothing
+    # about staging reuse).
+    ap.add_argument("--rows", type=int, default=1000)
+    ap.add_argument("--stored", type=int, default=112,
+                    help="stored (native) image edge")
+    ap.add_argument("--target", type=int, default=224,
+                    help="model input edge")
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--process", action="store_true",
+                    help="also run the f32 feed on the process decode pool")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+    rec = run(rows=args.rows, stored=args.stored, target=args.target,
+              batch_size=args.batch_size, workers=args.workers,
+              with_process=args.process)
+    if args.json:
+        print(json.dumps(rec, indent=2))
+    else:
+        for name, leg in rec["legs"].items():
+            print(f"{name:12s} {leg['rows_per_sec']:10.1f} rows/s  "
+                  f"{leg['wire_bytes_per_row']:9d} B/row")
+        d = rec["deltas"]
+        print(f"u8_fused vs f32_host: {d['rows_per_sec_vs_f32_host']}x "
+              f"rows/s, {d['wire_bytes_ratio_f32_over_u8']}x fewer "
+              f"wire bytes")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

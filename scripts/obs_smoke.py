@@ -17,9 +17,14 @@ live per-rank snapshot file appears MID-run (before the stream
 finishes), then runs ``scripts/bottleneck_report.py`` over the span
 streams + snapshots and asserts it names ``decode`` — the expected
 host-side stage — as the bottleneck with internally consistent busy
-fractions.
+fractions. Second half (ISSUE 7): the same report over a REAL
+image-scoring run — the workload whose Arrow decode/pack/resize was the
+pre-ISSUE-7 bottleneck — must no longer name ``decode`` dominant: the
+fused feed ships zero-copy uint8 views and the compiled program does
+flip/cast/resize, so decode time collapses and attribution moves to the
+device stages.
 
-Prints one JSON line; exits 0 iff both legs held.
+Prints one JSON line; exits 0 iff all legs held.
 
 Run: ``JAX_PLATFORMS=cpu python scripts/obs_smoke.py``
 """
@@ -151,6 +156,67 @@ def _scoring_leg(out_dir: str) -> dict:
             os.environ.pop(v, None)
 
 
+def _ingest_leg(out_dir: str) -> dict:
+    """ISSUE 7: the decode-bound workload the host-ingest PR attacked —
+    uniform uint8 image column through ``XlaImageTransformer`` — must NO
+    LONGER attribute to ``decode``: the fused feed ships zero-copy views
+    (near-zero host decode) and the compiled prologue does
+    flip/cast/resize, so the report names a device stage instead."""
+    import subprocess
+
+    event_dir = os.path.join(out_dir, "ingest_events")
+    os.environ["SPARKDL_EVENT_DIR"] = event_dir
+    try:
+        import numpy as np
+        import pyarrow as pa
+
+        import sparkdl_tpu as sdl
+        from sparkdl_tpu.image import imageIO
+        from sparkdl_tpu.runner import events
+
+        events.reset()  # re-arm the stream on the fresh event dir
+        rng = np.random.default_rng(0)
+        structs = [imageIO.imageArrayToStruct(
+            rng.integers(0, 256, (8, 8, 3), np.uint8), origin=f"m{i}")
+            for i in range(64)]
+        df = sdl.DataFrame.fromArrow(
+            pa.table({"image": pa.array(structs,
+                                        type=imageIO.imageSchema)}),
+            numPartitions=2)
+        t = sdl.XlaImageTransformer(
+            inputCol="image", outputCol="feat",
+            fn=lambda b: b.mean(axis=(1, 2)), inputSize=(16, 16),
+            batchSize=8)
+        n_rows = len(t.transform(df).collect())
+        events.reset()  # close the stream so the report reads full books
+    finally:
+        os.environ.pop("SPARKDL_EVENT_DIR", None)
+
+    report = {}
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(_REPO, "scripts", "bottleneck_report.py"),
+         event_dir, "--json"],
+        capture_output=True, text=True, timeout=120)
+    if proc.returncode == 0:
+        for line in reversed(proc.stdout.strip().splitlines()):
+            if line.startswith("{"):
+                report = json.loads(line)
+                break
+    rep = report.get("report") or {}
+    stages = rep.get("stages") or {}
+    decode_frac = (stages.get("decode") or {}).get("busy_frac")
+    return {
+        "scored_rows": n_rows,
+        "report_rc": proc.returncode,
+        "dominant_stage": rep.get("dominant_stage"),
+        "decode_busy_frac": decode_frac,
+        "ok": n_rows == 64
+        and rep.get("dominant_stage") is not None
+        and rep.get("dominant_stage") != "decode",
+    }
+
+
 def main() -> int:
     out_dir = tempfile.mkdtemp(prefix="sparkdl-obs-smoke-")
     event_dir = os.path.join(out_dir, "events")
@@ -183,7 +249,8 @@ def main() -> int:
                      and on_disk.get("first_failing_rank") == 0
                      and "UNAVAILABLE" in str(err))
     telemetry = _scoring_leg(out_dir)
-    ok = postmortem_ok and telemetry["ok"]
+    ingest = _ingest_leg(out_dir)
+    ok = postmortem_ok and telemetry["ok"] and ingest["ok"]
     print(json.dumps({
         "ok": ok,
         "postmortem_ok": postmortem_ok,
@@ -194,6 +261,7 @@ def main() -> int:
         if tl else None,
         "gang_timeline": merged_path,
         "telemetry": telemetry,
+        "ingest": ingest,
         "out_dir": out_dir,
     }))
     return 0 if ok else 1

@@ -276,6 +276,13 @@ class DataFrame:
             buffered -= n
             if len(take) == 1 and take[0].num_rows == n:
                 return take[0]
+            if hasattr(pa, "concat_batches"):
+                # Single-copy splice (ISSUE 7): the spanning batch's rows
+                # land once in fresh contiguous buffers — no intermediate
+                # Table + combine_chunks round-trip — so the downstream
+                # zero-copy column views (imageColumnNHWCView) see the
+                # back-to-back layout they need.
+                return pa.concat_batches(take)
             t = pa.Table.from_batches(take).combine_chunks()
             return t.to_batches(max_chunksize=n)[0]
 

@@ -34,6 +34,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import ingest
+
 log = logging.getLogger("sparkdl_tpu.runtime")
 
 
@@ -197,55 +199,10 @@ def transfer_workers_default() -> int:
     return int(os.environ.get("SPARKDL_TRANSFER_WORKERS", "0"))
 
 
-def _windowed_apply(fn: Callable, items: Iterable, depth: int, workers: int,
-                    thread_prefix: str) -> Iterator:
-    """THE submit-ahead window (one copy: the HBM put feed, the decode
-    pool, and run_stream's put stage all ride it): apply ``fn`` to each
-    item keeping up to ``depth`` results in flight ahead of the consumer,
-    yielding strictly in input order.
-
-    ``workers <= 0`` applies inline — with ``depth > 0`` results are still
-    produced ahead into the window (right for async-returning fns like
-    ``device_put``: the transfer proceeds while earlier results are
-    consumed), with ``depth <= 0`` it is a plain lazy map. ``workers > 0``
-    submits to a thread pool with in-flight depth ``max(depth, workers)``
-    (idle threads would defeat the knob); exceptions re-raise at the
-    consumption point, and closing the generator cancels un-started work.
-    """
-    it = iter(items)
-    window: collections.deque = collections.deque()
-    sentinel = object()
-    if workers <= 0:
-        if depth <= 0:
-            for item in it:
-                yield fn(item)
-            return
-        for item in itertools.islice(it, depth):
-            window.append(fn(item))
-        while window:
-            out = window.popleft()
-            nxt = next(it, sentinel)
-            if nxt is not sentinel:
-                window.append(fn(nxt))
-            yield out
-        return
-    from concurrent.futures import ThreadPoolExecutor
-    depth = max(depth, workers, 1)
-    pool = ThreadPoolExecutor(max_workers=workers,
-                              thread_name_prefix=thread_prefix)
-    try:
-        for item in itertools.islice(it, depth):
-            window.append(pool.submit(fn, item))
-        while window:
-            fut = window.popleft()
-            nxt = next(it, sentinel)
-            if nxt is not sentinel:
-                window.append(pool.submit(fn, nxt))
-            yield fut.result()
-    finally:
-        for f in window:
-            f.cancel()
-        pool.shutdown(wait=False, cancel_futures=True)
+# THE submit-ahead window — one copy, in the jax-free ingest module so
+# the host-only bench (scripts/ingest_bench.py) measures the exact
+# pipeline the runtime runs; every feed path here rides it.
+_windowed_apply = ingest.windowed_apply
 
 
 def _put_fn(sharding: NamedSharding | None) -> Callable:
@@ -409,11 +366,12 @@ def decode_workers_default() -> int:
 
 
 def parallel_map_iter(fn: Callable, items: Iterable, workers: int | None = None,
-                      maxsize: int | None = None) -> Iterator:
+                      maxsize: int | None = None,
+                      backend: str | None = None) -> Iterator:
     """Order-preserving parallel map over an iterator — the host decode pool.
 
     Up to ``max(workers, maxsize)`` applications of ``fn`` stay in flight on
-    a thread pool; results yield strictly in submission order, so a
+    a worker pool; results yield strictly in submission order, so a
     slow-to-decode chunk never reorders the stream. Like
     :func:`prefetch_to_device`, submission is pull-driven: each yield tops
     the window back up, so the pool runs ahead of the consumer by the
@@ -422,9 +380,43 @@ def parallel_map_iter(fn: Callable, items: Iterable, workers: int | None = None,
     whatever has not started.
 
     ``workers=None`` → :func:`decode_workers_default`; ``workers<=0`` maps
-    inline (serial).
+    inline (serial). ``backend`` (default: ``SPARKDL_DECODE_BACKEND``):
+    ``thread``, or ``process`` to run ``fn`` on the shared
+    ``ProcessPoolExecutor`` (``ingest.get_decode_executor``) — GIL-bound
+    decode then scales past ~2 workers, but ``fn`` and every item must be
+    picklable (the streaming scorer ships module-level factories +
+    compacted Arrow chunks; see ``ingest.run_decode_task``). Callers
+    whose ``fn`` closes over un-picklable state pass ``backend="thread"``
+    explicitly rather than inheriting the env.
     """
     workers = decode_workers_default() if workers is None else int(workers)
+    if backend is None:
+        backend = ingest.decode_backend_default()
+    if backend == "process" and workers > 0:
+        pool = ingest.acquire_decode_executor(workers)
+        try:
+            # stall_s: a pool child deadlocked at fork (the documented
+            # fork-a-threaded-parent hazard) must surface as a classified
+            # decode stall, not an eternal hang — armed BY DEFAULT
+            # (ingest.decode_stall_resolved), unlike the opt-in
+            # dispatch/fetch watchdog, because the hang needs no device
+            # wedge to happen; a SET SPARKDL_DISPATCH_TIMEOUT_S (incl.
+            # an explicit 0 = off) takes precedence.
+            yield from _windowed_apply(
+                fn, items, max(workers, maxsize or 0), workers, "",
+                executor=pool,
+                stall_s=ingest.decode_stall_resolved(),
+                stall_stage="decode")
+        except _failures().ScoringStallError:
+            # The stalled future's child is wedged but ALIVE — it never
+            # sets _broken, so the cached pool would re-stall every
+            # later stream on a permanently lost worker slot. Evict it;
+            # the next request builds fresh workers.
+            ingest.invalidate_decode_executor(pool)
+            raise
+        finally:
+            ingest.release_decode_executor()
+        return
     # depth 0 when inline: decode is synchronous CPU work — running it
     # ahead on the consumer thread would serialize identically, unlike
     # the async device_put feed.
@@ -463,7 +455,8 @@ class BatchRunner:
     def __init__(self, fn: Callable, batch_size: int,
                  donate: bool | None = None,
                  prefetch: int = 2, mesh: Mesh | None = None,
-                 data_axis: str = "data", input_cast=None):
+                 data_axis: str = "data", input_cast=None,
+                 preprocess: Callable | None = None):
         """``mesh``: when given, input batches are device_put *sharded* over
         ``data_axis`` and the jitted program runs SPMD across all mesh
         devices (the reference's partition-parallel inference, SURVEY.md
@@ -474,6 +467,16 @@ class BatchRunner:
         cast to it *inside* the jitted program. Feed uint8 host batches and
         the cast fuses into the first consumer op — 4x fewer bytes over the
         host→HBM link than pre-cast float32 feeds.
+
+        ``preprocess``: a jittable fn applied INSIDE the compiled program
+        between the input cast and ``fn`` — the fused preprocess prologue
+        (ISSUE 7): channel flips / ``jax.image.resize`` / normalization
+        compile into the same XLA program as the model, so the host ships
+        raw storage-dtype batches and does zero per-pixel math. Input
+        shapes are static at trace time, so a prologue may branch on
+        ``x.shape`` (e.g. resize only when the wire size differs from the
+        model size); each distinct wire shape is one compilation, visible
+        as a ``recompile`` event.
 
         ``donate``: donate the input buffer to the program — XLA may alias
         it for outputs/scratch, shaving one HBM buffer per in-flight batch.
@@ -496,6 +499,11 @@ class BatchRunner:
         else:
             self._sharding = None
         self.prefetch = prefetch
+        if preprocess is not None:
+            inner_fn = fn
+
+            def fn(batch):  # noqa: F811 — deliberate wrap
+                return inner_fn(preprocess(batch))
         if input_cast is not None:
             inner = fn
 
@@ -555,12 +563,32 @@ class BatchRunner:
         backoff_s = dispatch_backoff_default()
         stall_s = dispatch_timeout_default()
         batch_ids = itertools.count()
+        # Reused host staging (ISSUE 7): short batches pad into POOLED
+        # per-shape buffers (acquired here, released once the batch's
+        # fetch completed — a buffer is never recycled while a possibly
+        # zero-copy-aliasing device_put might still read it) instead of
+        # a fresh np.concatenate per batch; full batches pass through
+        # untouched, so a zero-copy Arrow view flows straight into
+        # device_put. SPARKDL_STAGE_BUFFERS=0 restores the old path.
+        staging = ingest.StagingPool() if ingest.stage_buffers_default() \
+            else None
 
         def staged():
             for b, meta in batches:
-                with ev.span("pad"):
-                    padded, n = pad_batch(b, self.batch_size)
-                yield padded, n, meta, next(batch_ids)
+                with ev.span("pad") as sp:
+                    if staging is not None:
+                        padded, n, lease, copied = ingest.stage_batch(
+                            b, self.batch_size, staging)
+                        # bytes here = host bytes COPIED to stage this
+                        # batch (0 = zero-copy pass-through): the proof
+                        # ledger that staging stopped re-copying the
+                        # stream, next to put's bytes-over-the-wire.
+                        sp.set(rows=n, bytes=copied)
+                    else:
+                        padded, n = pad_batch(b, self.batch_size)
+                        lease = None
+                        sp.set(rows=n)
+                yield padded, n, meta, next(batch_ids), lease
 
         put = _put_fn(self._sharding)
 
@@ -570,7 +598,7 @@ class BatchRunner:
             # prefetch_to_device, with SPARKDL_TRANSFER_WORKERS pooling.
             # The padded host batch is kept only while retries are
             # enabled: it is what the re-dispatch path re-puts.
-            padded, n, meta, idx = slot
+            padded, n, meta, idx, lease = slot
             # rows/bytes on the put span: host→HBM traffic is the
             # telemetry plane's bytes-moved ledger (the PCIe/wire story
             # ROADMAP item 2 is chasing); nbytes is attr reads, not math.
@@ -578,7 +606,7 @@ class BatchRunner:
                          for leaf in jax.tree_util.tree_leaves(padded))
             with ev.span("put", rows=n, bytes=nbytes):
                 return put(padded), (padded if retries else None), n, \
-                    meta, idx
+                    meta, idx, lease
 
         def put_stream():
             return _windowed_apply(put_slot, staged(), self.prefetch,
@@ -656,7 +684,7 @@ class BatchRunner:
                     exc = e
 
         def fetch(slot):
-            out, host, n, meta, idx, state = slot
+            out, host, n, meta, idx, state, lease = slot
             failures = _failures()
             while True:
                 try:
@@ -667,6 +695,11 @@ class BatchRunner:
                                     np.asarray, out), stall_s, "fetch")
                         else:
                             out_np = jax.tree_util.tree_map(np.asarray, out)
+                    if lease is not None:
+                        # Fetch completed ⇒ this batch's transfer AND
+                        # compute are done — only now may its staging
+                        # buffer be recycled for a later batch.
+                        staging.release(lease)
                     return (jax.tree_util.tree_map(lambda x: x[:n], out_np),
                             meta)
                 except failures.ScoringStallError:
@@ -682,7 +715,7 @@ class BatchRunner:
                     out = retry_or_raise("fetch", e, host, n, idx, state)
 
         window: collections.deque = collections.deque()
-        for dev_batch, host, n, meta, idx in put_stream():
+        for dev_batch, host, n, meta, idx, lease in put_stream():
             state = {"attempts": 1}
             try:
                 out = dispatch_once(dev_batch, n, idx)
@@ -694,7 +727,7 @@ class BatchRunner:
                 raise
             except Exception as e:  # noqa: BLE001 — reclassified
                 out = retry_or_raise("dispatch", e, host, n, idx, state)
-            window.append((out, host, n, meta, idx, state))
+            window.append((out, host, n, meta, idx, state, lease))
             oldest = window.popleft() if len(window) > self.prefetch \
                 else None
             if depth_gauge is not None:
@@ -717,6 +750,38 @@ class BatchRunner:
 def run_batched(fn: Callable, batches: Iterable, batch_size: int,
                 prefetch: int = 2) -> Iterator:
     return BatchRunner(fn, batch_size, prefetch=prefetch).run(batches)
+
+
+# ---------------------------------------------------------------------------
+# Shape-cached jitted NHWC resize (the fused-preprocess building block)
+# ---------------------------------------------------------------------------
+
+_RESIZE_JITS: dict[tuple, Callable] = {}
+
+
+def jit_resize_nhwc(height: int, width: int,
+                    method: str = "bilinear") -> Callable:
+    """One jitted ``jax.image.resize``-to-``(height, width)`` per target
+    (+ method), cached for the process lifetime.
+
+    ``jax.image.resize`` called bare re-traces (and eagerly re-dispatches
+    the gather chain) on EVERY call; wrapping it in a cached ``jax.jit``
+    makes each (input shape → target) pair one compilation ever, with
+    jit's own signature cache handling per-shape reuse. The returned fn
+    maps NHWC (device or host) batches to a DEVICE array — callers
+    feeding ``device_put``/another jit keep it on device instead of
+    forcing a host round-trip."""
+    key = (int(height), int(width), str(method))
+    fn = _RESIZE_JITS.get(key)
+    if fn is None:
+        h, w = key[0], key[1]
+
+        def _resize(x):
+            return jax.image.resize(x, (x.shape[0], h, w, x.shape[-1]),
+                                    method=method)
+
+        fn = _RESIZE_JITS.setdefault(key, jax.jit(_resize))
+    return fn
 
 
 # ---------------------------------------------------------------------------

@@ -170,17 +170,24 @@ def createResizeImageUDF(height: int, width: int):
     return resize
 
 
-def resizeImageBatchNHWC(batch: np.ndarray, height: int, width: int) -> np.ndarray:
+def resizeImageBatchNHWC(batch: np.ndarray, height: int, width: int,
+                         device: bool = False) -> np.ndarray:
     """Vectorized NHWC resize on device-bound data.
 
     Uses ``jax.image.resize`` (XLA gather-based bilinear) so resize fuses into
     the same compiled program as preprocessing — the reference instead resized
     row-at-a-time in a Spark UDF (SURVEY.md §3.1 step 2).
+
+    The resize is jitted and shape-cached (``runtime.jit_resize_nhwc``):
+    one compilation per (input shape, target), where the old bare
+    ``jax.image.resize`` call re-traced its gather chain on EVERY call.
+    ``device=True`` returns the device array as-is — callers feeding
+    ``jax.device_put``/another jitted program skip the forced
+    ``np.asarray`` host sync entirely.
     """
-    import jax
-    n, _, _, c = batch.shape
-    return np.asarray(jax.image.resize(
-        batch, (n, height, width, c), method="bilinear"))
+    from ..core.runtime import jit_resize_nhwc
+    out = jit_resize_nhwc(height, width)(batch)
+    return out if device else np.asarray(out)
 
 
 def _narrowing_safe(img: np.ndarray, out_dtype) -> np.ndarray:
@@ -278,6 +285,141 @@ def imageColumnToNHWC(column: pa.Array, height: int | None = None,
             img = imageStructToArray(resizeImage(struct, h, w))
         out[i] = _narrowing_safe(_swapRB(img) if flip else img, out.dtype)
     return out
+
+
+def imageColumnUniformSize(column: pa.Array) -> tuple | None:
+    """``(height, width, nChannels, mode)`` when EVERY row of the
+    image-struct column stores the same values and no row is null — the
+    METADATA-ONLY precondition of :func:`imageColumnNHWCView` (int-field
+    reads, no buffer-layout inspection, no pixel work). Callers use it to
+    decide a feed policy for a chunk without decoding it (the wire-shape
+    cap in ``XlaImageTransformer``)."""
+    if isinstance(column, pa.ChunkedArray):
+        column = column.combine_chunks()
+    n = len(column)
+    if n == 0 or column.null_count:
+        return None
+    try:
+        heights = column.field("height").to_numpy(zero_copy_only=False)
+        widths = column.field("width").to_numpy(zero_copy_only=False)
+        chans = column.field("nChannels").to_numpy(zero_copy_only=False)
+        modes = column.field("mode").to_numpy(zero_copy_only=False)
+    except (KeyError, pa.ArrowInvalid):
+        return None
+    h, w, c, mode = (int(heights[0]), int(widths[0]), int(chans[0]),
+                     int(modes[0]))
+    if not ((heights == h).all() and (widths == w).all()
+            and (chans == c).all() and (modes == mode).all()):
+        return None
+    return h, w, c, mode
+
+
+def imageColumnNHWCView(column: pa.Array,
+                        uniform: tuple | None = None) -> np.ndarray | None:
+    """ZERO-COPY NHWC view over a uniform image-struct column.
+
+    When every row stores the same (height, width, nChannels, mode) and
+    the binary child's rows sit back-to-back (no nulls, uniform lengths —
+    the layout every writer here produces), the Arrow values buffer IS an
+    NHWC batch: one ``np.frombuffer`` reshape, no per-row work, no copy.
+    Returns the **storage-dtype, at-rest BGR(A)** view (read-only — it
+    aliases the immutable Arrow buffer), or ``None`` whenever any layout
+    precondition fails, in which case the caller takes a packing path.
+
+    ``uniform``: a precomputed :func:`imageColumnUniformSize` result for
+    this exact column — skips the metadata re-scan on the hot path (the
+    wire-shape budget in ``XlaImageTransformer`` already ran it).
+
+    This is the host-ingest fast path (ISSUE 7): decode cost for a
+    uniform uint8 column drops to ~zero, and the view flows straight into
+    ``device_put`` with channel-flip/cast/resize fused into the jitted
+    program (``BatchRunner(preprocess=...)``).
+    """
+    if isinstance(column, pa.ChunkedArray):
+        column = column.combine_chunks()
+    meta = uniform if uniform is not None else imageColumnUniformSize(column)
+    if meta is None:
+        return None
+    h, w, c, mode = meta
+    n = len(column)
+    try:
+        data = column.field("data")
+    except (KeyError, pa.ArrowInvalid):
+        return None
+    if mode not in _OCV_BY_ORD:
+        return None  # let the packing path raise its informative error
+    dt = np.dtype(_OCV_BY_ORD[mode].dtype)
+    if pa.types.is_binary(data.type):
+        off_dtype = np.dtype(np.int32)
+    elif pa.types.is_large_binary(data.type):
+        off_dtype = np.dtype(np.int64)
+    else:
+        return None
+    if data.null_count:
+        return None
+    bufs = data.buffers()
+    offsets = np.frombuffer(
+        bufs[1], dtype=off_dtype, count=n + 1,
+        offset=data.offset * off_dtype.itemsize)
+    row_bytes = h * w * c * dt.itemsize
+    if not (np.diff(offsets) == row_bytes).all():
+        return None
+    view = np.frombuffer(
+        bufs[2], dtype=dt, count=n * h * w * c,
+        offset=int(offsets[0])).reshape(n, h, w, c)
+    view.flags.writeable = False  # aliases Arrow memory — never mutate
+    return view
+
+
+def imageColumnFeed(column: pa.Array, height: int, width: int,
+                    dtype=np.float32, channelOrder: str = "RGB",
+                    fused: bool = True, native_ok: bool = True,
+                    uniform: tuple | None = None) -> np.ndarray:
+    """Feed-side decode policy for the streaming scorer (ISSUE 7).
+
+    ``fused=True`` (the ``SPARKDL_FUSED_PREPROCESS`` default) pairs with a
+    jitted preprocess prologue that does flip/cast/resize on device, so
+    the host ships the cheapest batch that policy allows:
+
+    - a uniform column whose stored size is ≤ the target's pixel count
+      returns the ZERO-COPY storage-dtype **BGR** view at its native size
+      (fewer or equal bytes over the wire than a target-size batch, zero
+      host pixel math; the device upsamples);
+    - anything else (mixed sizes, nulls, stored > target — downsampling
+      on device would INFLATE wire bytes, fatal on a ~40 MB/s tunnel)
+      packs to the target size in ``dtype``, still **BGR** — the prologue
+      owns the flip either way, so every chunk of a stream agrees.
+
+    ``fused=False`` is the legacy host path: pack to target size in
+    ``dtype`` with ``channelOrder`` applied on the host.
+
+    Single-row columns always pack: the quarantine row-fallback re-decodes
+    a failed chunk one row at a time, and a 1-row slice is trivially
+    "uniform" — shipping it at native size would make every mixed-size
+    row's shape deviate from the fallback's modal shape and dead-letter
+    valid rows (the chunk view path cannot raise, so a fallback only ever
+    follows a failed PACK — packing the rows matches it). Costs at most
+    one extra wire shape for a legitimate 1-row tail chunk.
+
+    ``native_ok=False`` forces the fused PACK path even for a shippable
+    uniform column — the caller's wire-shape budget said no (every
+    distinct native size is one XLA compilation; ``XlaImageTransformer``
+    caps how many a stage may introduce, ``SPARKDL_MAX_WIRE_SHAPES``).
+    ``uniform``: precomputed metadata for this column, forwarded to
+    :func:`imageColumnNHWCView` so the uniform-size scan runs once.
+    """
+    if isinstance(column, pa.ChunkedArray):
+        column = column.combine_chunks()
+    if fused:
+        if native_ok and len(column) > 1:
+            view = imageColumnNHWCView(column, uniform=uniform)
+            if view is not None and \
+                    view.shape[1] * view.shape[2] <= int(height) * int(width):
+                return view
+        return imageColumnToNHWC(column, height, width, dtype=dtype,
+                                 channelOrder="BGR")
+    return imageColumnToNHWC(column, height, width, dtype=dtype,
+                             channelOrder=channelOrder)
 
 
 def _pack_gate(modes, dtype) -> bool:

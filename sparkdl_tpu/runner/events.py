@@ -127,6 +127,13 @@ class _Span(Timer):
         self._rec.emit(self._name, "B", self._attrs)
         return self
 
+    def set(self, **attrs) -> "_Span":
+        """Attach attrs discovered INSIDE the region (bytes copied, rows
+        staged) — they land on the end event; the begin event has already
+        been emitted without them."""
+        self._attrs.update(attrs)
+        return self
+
     def __exit__(self, exc_type, exc, tb):
         block_err = None
         try:
@@ -202,9 +209,10 @@ class FlightRecorder:
         self._stream_capped = False
 
     # -- emission ---------------------------------------------------------
-    def emit(self, name: str, ph: str = "P", attrs: dict | None = None):
-        rec = {"t": round(time.time(), 6), "name": name, "ph": ph,
-               "rank": _rank()}
+    def emit(self, name: str, ph: str = "P", attrs: dict | None = None,
+             t: float | None = None):
+        rec = {"t": round(time.time() if t is None else t, 6),
+               "name": name, "ph": ph, "rank": _rank()}
         if attrs:
             rec.update(attrs)
         self.ring.append(rec)
@@ -224,6 +232,21 @@ class FlightRecorder:
 
     def span(self, name: str, block_on=None, **attrs) -> _Span:
         return _Span(self, name, block_on=block_on, **attrs)
+
+    def completed_span(self, name: str, dur_s: float, **attrs):
+        """Land a span that ALREADY ran (its region executed where this
+        recorder could not see it — a process-pool child whose ring dies
+        with the child): B back-dated by ``dur_s``, E now. Downstream
+        consumers (`analysis`, the telemetry accountant) read E events'
+        ``t - dur_s``, so attribution matches a live span up to the
+        child→parent hand-off delay; concurrent child regions reported
+        sequentially can overlap-union slightly high, which `analysis`
+        clamps."""
+        t1 = time.time()
+        self.emit(name, "B", attrs, t=t1 - max(0.0, dur_s))
+        end = dict(attrs)
+        end["dur_s"] = round(max(0.0, dur_s), 6)
+        self.emit(name, "E", end, t=t1)
 
     def _write(self, d: str, rec: dict):
         try:
@@ -368,6 +391,10 @@ def event(name: str, **attrs):
 
 def span(name: str, block_on=None, **attrs) -> _Span:
     return get_recorder().span(name, block_on=block_on, **attrs)
+
+
+def completed_span(name: str, dur_s: float, **attrs) -> None:
+    get_recorder().completed_span(name, dur_s, **attrs)
 
 
 def postmortem(exc: BaseException | None = None, **attrs) -> dict:

@@ -46,15 +46,19 @@ per-partition design had, now without the per-boundary stalls.
 from __future__ import annotations
 
 import collections
+import logging
 import os
 from typing import Callable, Iterator
 
 import numpy as np
 import pyarrow as pa
 
+from ..core import ingest
 from ..core.frame import _set_column
 from ..core.runtime import (BatchRunner, _chaos, _events, _failures,
                             _run_stats, _telemetry, parallel_map_iter)
+
+log = logging.getLogger("sparkdl_tpu.streaming")
 
 ERROR_CLASS_COL = "error_class"
 ERROR_COL = "error"
@@ -184,6 +188,19 @@ class StreamScorer:
     scored output batch simply omits them (length-changing — pair with
     ``mapStream(..., changes_length=True)``). ``max_quarantine_frac``
     bounds the damage (default: :func:`max_quarantine_frac_default`).
+
+    ``decoder_spec`` (optional) makes the scorer eligible for the
+    PROCESS decode backend (``SPARKDL_DECODE_BACKEND=process`` — GIL-
+    bound decode scales past the ~1-core thread ceiling):
+    ``decoder_spec(batch) -> spec`` where ``spec(start, length)`` returns
+    a PICKLABLE ``(factory, payload)`` pair — ``factory`` a module-level
+    callable decoding rows of that chunk from ``payload`` with
+    chunk-local indices (see ``ingest.decode_image_chunk``). Without a
+    spec, a process-backend request degrades to threads with one warning
+    (entries/decoders close over Arrow batches and device state — not
+    picklable). Chunk decode semantics — row-fallback quarantine, the
+    chaos ``decode`` site — are the ONE shared implementation
+    (``ingest.decode_chunk``) on either backend.
     """
 
     def __init__(self, runner: BatchRunner, out_col: str,
@@ -192,7 +209,8 @@ class StreamScorer:
                  decode_workers: int | None = None,
                  on_error: str = "raise",
                  max_quarantine_frac: float | None = None,
-                 sink: QuarantineSink | None = None):
+                 sink: QuarantineSink | None = None,
+                 decoder_spec: Callable | None = None):
         if on_error not in ("raise", "quarantine"):
             raise ValueError(f"on_error must be 'raise' or 'quarantine', "
                              f"got {on_error!r}")
@@ -204,6 +222,7 @@ class StreamScorer:
         self.chunk_rows = int(chunk_rows or runner.batch_size)
         self.decode_workers = decode_workers
         self.on_error = on_error
+        self.decoder_spec = decoder_spec
         self.max_quarantine_frac = (
             max_quarantine_frac if max_quarantine_frac is not None
             else max_quarantine_frac_default())
@@ -212,49 +231,15 @@ class StreamScorer:
 
     # -- stages ------------------------------------------------------------
     def _decode(self, item):
-        """Decode one chunk (pool thread). Returns ``(array_or_None,
-        entry, info)`` — ``info`` is None in raise mode; in quarantine
-        mode it carries the chunk length and the dead rows so ALL sink /
-        counter mutation happens later on the consumer thread."""
-        decoder, start, length, entry = item
+        """Decode one chunk (thread-pool path). Returns ``(array_or_None,
+        info)`` — ``info`` is None in raise mode; in quarantine mode it
+        carries the chunk length and the dead rows so ALL sink / counter
+        mutation happens later on the consumer thread. The chunk/row-
+        fallback protocol itself is the shared ``ingest.decode_chunk``."""
+        decoder, start, length = item
         with _events().span("decode", rows=length):
-            if self.on_error != "quarantine":
-                _chaos().fire("decode")
-                return decoder(start, length), entry, None
-            try:
-                _chaos().fire("decode")
-                return decoder(start, length), entry, \
-                    {"length": length, "dead": []}
-            except Exception:  # noqa: BLE001 — row fallback re-derives
-                return self._decode_rows(decoder, start, length, entry)
-
-    def _decode_rows(self, decoder, start, length, entry):
-        """Row-level quarantine fallback: re-decode the failed chunk one
-        row at a time; rows that still raise — or decode clean but with a
-        deviant trailing shape that would crash the batch concat or
-        recompile the program — are dead-lettered instead of killing the
-        stream."""
-        arrs, rows, dead = [], [], []
-        for j in range(start, start + length):
-            try:
-                _chaos().fire("decode")
-                arrs.append(decoder(j, 1))
-                rows.append(j)
-            except Exception as e:  # noqa: BLE001 — becomes the dead letter
-                dead.append((j, type(e).__name__, str(e)))
-        if arrs:
-            modal = collections.Counter(
-                a.shape[1:] for a in arrs).most_common(1)[0][0]
-            kept = [(a, r) for a, r in zip(arrs, rows)
-                    if a.shape[1:] == modal]
-            dead.extend((r, "ShapeMismatch",
-                         f"row decodes to shape {a.shape[1:]}, chunk "
-                         f"decodes to {modal}")
-                        for a, r in zip(arrs, rows) if a.shape[1:] != modal)
-            arrs = [a for a, _ in kept]
-        dead.sort()
-        arr = np.concatenate(arrs, axis=0) if arrs else None
-        return arr, entry, {"length": length, "dead": dead}
+            return ingest.decode_chunk(decoder, start, length,
+                                       self.on_error == "quarantine")
 
     def _encode(self, result: np.ndarray) -> pa.Array:
         with _events().span("encode", rows=len(result)):
@@ -312,34 +297,83 @@ class StreamScorer:
                     totals["quarantined"], totals["seen"],
                     self.max_quarantine_frac)
 
+        # Decode backend resolution (ISSUE 7): the process pool needs
+        # picklable tasks, which only scorers WITH a decoder_spec can
+        # build; everything else rides threads exactly as before. The
+        # chunk FIFO pairs each in-order decode result back with its
+        # partition entry (entries hold RecordBatches and futures — they
+        # never cross the process boundary).
+        process_mode = ingest.decode_backend_default() == "process" \
+            and (self.decode_workers is None or self.decode_workers > 0)
+        if process_mode and self.decoder_spec is None:
+            log.warning(
+                "SPARKDL_DECODE_BACKEND=process but this scorer has no "
+                "decoder_spec (its decoder closes over un-picklable "
+                "state); decoding on threads instead")
+            process_mode = False
+        quarantine = self.on_error == "quarantine"
+        chaos_json = None
+        if process_mode:
+            plan = _chaos().active_plan()
+            chaos_json = plan.to_json() if plan is not None else None
+        fifo: collections.deque[tuple] = collections.deque()
+
         def chunk_stream():
             for rb in parts:
                 if run_sink is not None and rb.num_rows == 0 \
                         and run_sink.schema is None:
                     run_sink.ensure_schema(rb.schema)
-                decoder = self.make_decoder(rb) if rb.num_rows else None
+                decoder = spec = None
+                if rb.num_rows:
+                    if process_mode:
+                        spec = self.decoder_spec(rb)
+                    else:
+                        decoder = self.make_decoder(rb)
                 starts = range(0, rb.num_rows, self.chunk_rows)
                 entry = {"batch": rb, "n_chunks": len(starts), "futs": [],
                          "n_skipped": 0, "dead": []}
                 pending.append(entry)
                 for s in starts:
-                    yield (decoder, s,
-                           min(self.chunk_rows, rb.num_rows - s), entry)
+                    length = min(self.chunk_rows, rb.num_rows - s)
+                    fifo.append((entry, s, length))
+                    if process_mode:
+                        factory, payload = spec(s, length)
+                        yield (factory, payload, length, quarantine,
+                               chaos_json)
+                    else:
+                        yield (decoder, s, length)
 
         def complete(entry: dict) -> bool:
             return len(entry["futs"]) + entry["n_skipped"] \
                 == entry["n_chunks"]
 
         decoded = parallel_map_iter(
-            self._decode, chunk_stream(), workers=self.decode_workers,
-            maxsize=max(self.runner.prefetch, 1))
+            ingest.run_decode_task if process_mode else self._decode,
+            chunk_stream(), workers=self.decode_workers,
+            maxsize=max(self.runner.prefetch, 1),
+            backend="process" if process_mode else "thread")
 
         def device_stream():
             """Consumer-thread filter between the decode pool and the
             device window: records quarantine bookkeeping (sink schema,
             entry dead rows, counters, the circuit breaker) and drops
             chunks with no surviving rows."""
-            for arr, entry, info in decoded:
+            for res in decoded:
+                entry, start, length = fifo.popleft()
+                if process_mode:
+                    arr, info, dur_s = res
+                    # The decode ran in a pool child whose recorder dies
+                    # with it — land the span HERE so stage accounting /
+                    # bottleneck reports still see decode time.
+                    ev.completed_span("decode", dur_s, rows=length)
+                    if info is not None and info["dead"]:
+                        # child indices are chunk-local; re-base onto the
+                        # partition batch
+                        info = {"length": info["length"],
+                                "dead": [(start + j, c, m)
+                                         for j, c, m in info["dead"]]}
+                else:
+                    arr, info = res
                 if info is not None:
                     totals["seen"] += info["length"]
                     if run_sink is not None and run_sink.schema is None:

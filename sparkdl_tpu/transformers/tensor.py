@@ -12,7 +12,12 @@ from __future__ import annotations
 import numpy as np
 import pyarrow as pa
 
+from ..core import ingest
 from ..core.frame import DataFrame
+from ..core.ingest import columnToNdarray  # noqa: F401 — historical home;
+# the implementation lives in core.ingest (no jax in that module's own
+# imports) so process-pool decode children run it without device state
+# (re-exported here for existing callers)
 from ..core.params import (HasBatchSize, HasInputCol, HasOnError,
                            HasOutputCol, Param, Params, TypeConverters,
                            keyword_only)
@@ -21,33 +26,6 @@ from ..core.runtime import BatchRunner
 from .keras_utils import keras_file_to_fn
 from .payloads import BundlesModelFile, PicklesCallableParams
 from .xla_image import arrayColumnToArrow
-
-
-def columnToNdarray(column: pa.Array, shape: tuple | None,
-                    dtype=np.float32, atleast_2d: bool = False) -> np.ndarray:
-    """list<float> / primitive column → (N, *shape) contiguous array.
-
-    ``atleast_2d``: promote a plain numeric column to (N, 1) — callers
-    that treat rows as vectors (feature stages) set this so scalar
-    columns work wherever vector columns do."""
-    if isinstance(column, pa.ChunkedArray):
-        column = column.combine_chunks()
-    if (pa.types.is_list(column.type)
-            or pa.types.is_large_list(column.type)
-            or pa.types.is_fixed_size_list(column.type)):
-        flat = column.flatten().to_numpy(zero_copy_only=False).astype(dtype)
-        n = len(column)
-        if shape:
-            return np.ascontiguousarray(flat.reshape((n,) + tuple(shape)))
-        if n and flat.size % n:
-            raise ValueError(f"Ragged array column: {flat.size} values over "
-                             f"{n} rows")
-        return np.ascontiguousarray(flat.reshape(n, -1) if n else
-                                    flat.reshape(0, 0))
-    arr = column.to_numpy(zero_copy_only=False).astype(dtype)
-    if shape:
-        return arr.reshape((len(arr),) + tuple(shape))
-    return arr[:, None] if atleast_2d else arr
 
 
 class XlaTransformer(PicklesCallableParams, Transformer, HasInputCol,
@@ -114,10 +92,22 @@ class XlaTransformer(PicklesCallableParams, Transformer, HasInputCol,
 
             return decode
 
+        def decoder_spec(batch: pa.RecordBatch):
+            # SPARKDL_DECODE_BACKEND=process eligibility: picklable
+            # per-chunk tasks (module-level factory + compacted slice).
+            col = batch.column(in_col)
+
+            def spec(start: int, length: int) -> tuple:
+                chunk = pa.concat_arrays([col.slice(start, length)])
+                return ingest.decode_array_chunk, (chunk, shape)
+
+            return spec
+
         on_error = self.getOnError()
         scorer = StreamScorer(runner, out_col, make_decoder,
                               arrayColumnToArrow, emptyVectorColumn,
-                              chunk_rows=batch_size, on_error=on_error)
+                              chunk_rows=batch_size, on_error=on_error,
+                              decoder_spec=decoder_spec)
         self._quarantine_sink = scorer.sink
         return dataset.mapStream(scorer,
                                  changes_length=on_error == "quarantine")

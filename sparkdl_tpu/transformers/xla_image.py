@@ -17,9 +17,12 @@ into compiler fusion.
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 import pyarrow as pa
 
+from ..core import ingest
 from ..core.frame import DataFrame
 from ..core.params import (HasBatchSize, HasInputCol, HasOnError,
                            HasOutputCol, Param, Params, TypeConverters,
@@ -122,22 +125,71 @@ class XlaImageTransformer(PicklesCallableParams, Transformer, HasInputCol,
             raise ValueError(f"numDevices={n} but only {len(devs)} visible")
         return runtime.make_mesh({"data": n}, devices_=devs[:n])
 
+    def _feed_key(self) -> tuple:
+        """The feed-side configuration the compiled program depends on:
+        fused mode changes the jitted prologue, size/order change what it
+        does — a runner compiled for one must not serve another."""
+        size = (tuple(self.getOrDefault(self.inputSize))
+                if self.isDefined(self.inputSize) else None)
+        return (ingest.fused_preprocess_default(), size,
+                self.getOrDefault(self.channelOrder).upper())
+
+    def _make_preprocess(self):
+        """Fused on-device preprocess prologue (ISSUE 7): with
+        ``SPARKDL_FUSED_PREPROCESS`` on (default), the host ships
+        storage-dtype **BGR** batches (zero-copy views at native size
+        when the column layout allows — see ``imageIO.imageColumnFeed``)
+        and the compiled program does the rest: cast (the runner's
+        ``input_cast``), BGR→RGB flip, and ``jax.image.resize`` to the
+        static input size when the wire size differs — all fused by XLA
+        into the model's first ops. Shapes are static at trace time, so
+        each distinct wire size is one compilation (a ``recompile``
+        event), and a wire size equal to the target skips the resize
+        entirely (bit-identical to the host-resized feed).
+
+        Fused mode requires a STATIC ``inputSize``: without one the target
+        shape is pinned per partition at decode time, which this prologue
+        (traced once per runner) cannot know — a native-size chunk would
+        ship and never be resized. No ``inputSize`` → no prologue, and the
+        feed stays on the legacy host pack path."""
+        if not ingest.fused_preprocess_default() \
+                or not self.isDefined(self.inputSize):
+            return None
+        size = self.getOrDefault(self.inputSize)
+        h, w = int(size[0]), int(size[1])
+        flip = self.getOrDefault(self.channelOrder).upper() == "RGB"
+        import jax
+        import jax.numpy as jnp
+
+        def prologue(x):
+            if flip and x.shape[-1] >= 3:
+                x = jnp.concatenate([x[..., 2::-1], x[..., 3:]], axis=-1)
+            if x.shape[1] != h or x.shape[2] != w:
+                x = jax.image.resize(
+                    x, (x.shape[0], h, w, x.shape[-1]), method="bilinear")
+            return x
+
+        return prologue
+
     def _get_runner(self) -> BatchRunner:
         """One BatchRunner (→ one XLA compilation) per param configuration.
 
         transform() is called repeatedly on the same stage (fit then
         transform, batch scoring jobs, ...); rebuilding the jit wrapper each
         time would recompile the model — the primary TPU perf failure mode."""
-        key = self._runner_key()
+        key = (self._runner_key(), self._feed_key())
         cached = getattr(self, "_runner_cache", None)
         if cached is not None and cached[0] == key:
             return cached[1]
         import jax.numpy as jnp
         # Host batches are fed as uint8 (4x fewer bytes over the host→HBM
         # link); the runner casts to f32 inside the program, where XLA fuses
-        # it into the first conv. ``fn`` still sees float32 NHWC.
+        # it into the first conv. ``fn`` still sees float32 NHWC (RGB when
+        # channelOrder says so — in fused mode the prologue owns the flip
+        # and the resize; see _make_preprocess).
         runner = BatchRunner(self._make_fn(), self.getBatchSize(),
-                             mesh=self._mesh(), input_cast=jnp.float32)
+                             mesh=self._mesh(), input_cast=jnp.float32,
+                             preprocess=self._make_preprocess())
         self._runner_cache = (key, runner)
         return runner
 
@@ -151,14 +203,78 @@ class XlaImageTransformer(PicklesCallableParams, Transformer, HasInputCol,
         batch_size = self.getBatchSize()
         runner = self._get_runner()
 
-        def make_decoder(batch: pa.RecordBatch):
-            # One Arrow partition may exceed the device batch: decode AND
-            # run per device-chunk, so peak host memory is O(batchSize)
-            # decoded pixels, not O(partition) (round-1 verdict weak #4).
-            # Each chunk decode runs on the parallel decode pool
-            # (SPARKDL_DECODE_WORKERS) while earlier chunks execute; the
-            # quarantine fallback calls the same decoder per row.
-            col = batch.column(in_col)
+        # Fused feed only when the prologue exists to own flip/resize —
+        # i.e. a static inputSize is defined (see _make_preprocess).
+        fused = ingest.fused_preprocess_default() \
+            and self.isDefined(self.inputSize)
+
+        # Wire-shape budget: every distinct native size this stage ships
+        # is one XLA compilation, so a many-sized dataset (per-directory
+        # dumps, size-sorted scans) must not recompile unboundedly where
+        # the host-pack feed compiled once. Shared by the thread decoder
+        # and the process spec (evaluated in the parent — pool children
+        # are stateless); metadata-only, no pixel work. The budget lives
+        # WITH the compiled program (one set per runner, like
+        # _runner_cache), not per transform() call: the jit cache it
+        # bounds is cumulative across calls, so the budget must be too.
+        if getattr(self, "_wire_budget_for", None) is not runner:
+            self._wire_budget = set()
+            self._wire_budget_lock = threading.Lock()
+            self._wire_budget_for = runner
+        wire_shapes = self._wire_budget
+        wire_lock = self._wire_budget_lock
+        max_wire = ingest.max_wire_shapes_default()
+
+        def chunk_native_ok(chunk_col, length, h, w):
+            """Wire-shape-budget verdict for one chunk: ``(native_ok,
+            uniform_meta)`` — may the feed ship it zero-copy at its
+            native size? A budget slot is consumed only for a chunk the
+            view can ACTUALLY deliver (the view attempt below): metadata
+            uniformity alone is not deliverability, and a slot burned for
+            a chunk whose view then declines (truncated payloads, exotic
+            storage) would strand that slot for the runner's lifetime on
+            a shape that only ever packs."""
+            if not fused or length <= 1:
+                return True, None  # 1-row chunks pack (fallback parity)
+            meta = imageIO.imageColumnUniformSize(chunk_col)
+            if meta is None:
+                return True, None  # not view-shippable; the feed packs
+            mh, mw = meta[0], meta[1]
+            if (mh, mw) == (h, w) or mh * mw > h * w:
+                return True, meta  # target-shaped / packs anyway
+            if imageIO.imageColumnNHWCView(chunk_col, uniform=meta) is None:
+                return True, meta  # layout declines; the feed packs
+            # Key on the FULL meta: the mode determines the view's
+            # storage DTYPE, and each distinct (shape, dtype) signature
+            # is its own XLA compilation — (h, w, c) alone would let a
+            # u8/f32 mix compile 2x the budgeted programs.
+            with wire_lock:
+                if meta in wire_shapes:
+                    return True, meta
+                if len(wire_shapes) < max_wire:
+                    wire_shapes.add(meta)
+                    return True, meta
+                return False, meta
+
+        def chunk_verdicts(col, num_rows, h, w) -> dict:
+            """native_ok per chunk start, evaluated HERE on the consumer
+            thread in stream order BEFORE any chunk decodes: pool workers
+            racing for the last budget slots would make native-vs-pack
+            assignment — and therefore the resize path and output bits —
+            depend on thread timing, and diverge between the thread and
+            process backends. Mirrors StreamScorer's chunking
+            (``chunk_rows=batch_size`` below); decode falls back to the
+            pack path for any unaligned start (the quarantine
+            row-fallback's 1-row decodes pack regardless)."""
+            if not fused:
+                return {}
+            out = {}
+            for s in range(0, num_rows, batch_size):
+                length = min(batch_size, num_rows - s)
+                out[s] = chunk_native_ok(col.slice(s, length), length, h, w)
+            return out
+
+        def feed_params(col: pa.Array) -> tuple:
             h, w = size
             if h is None or w is None:
                 # No static inputSize: pin the partition-wide target shape
@@ -174,13 +290,52 @@ class XlaImageTransformer(PicklesCallableParams, Transformer, HasInputCol,
             feed_dtype = (np.uint8 if all(
                 imageIO.ocvTypeByMode(int(m)).dtype == "uint8"
                 for m in np.unique(modes)) else np.float32)
+            return h, w, feed_dtype
+
+        def make_decoder(batch: pa.RecordBatch):
+            # One Arrow partition may exceed the device batch: decode AND
+            # run per device-chunk, so peak host memory is O(batchSize)
+            # decoded pixels, not O(partition) (round-1 verdict weak #4).
+            # Each chunk decode runs on the parallel decode pool
+            # (SPARKDL_DECODE_WORKERS) while earlier chunks execute; the
+            # quarantine fallback calls the same decoder per row. In fused
+            # mode (ISSUE 7) imageColumnFeed ships the cheapest batch the
+            # policy allows (zero-copy native-size storage-dtype views
+            # when the layout permits) and the runner's prologue does
+            # flip/cast/resize on device.
+            col = batch.column(in_col)
+            h, w, feed_dtype = feed_params(col)
+            native = chunk_verdicts(col, batch.num_rows, h, w)
 
             def decode(start: int, length: int) -> np.ndarray:
-                return imageIO.imageColumnToNHWC(
+                ok, uniform = native.get(start, (False, None))
+                return imageIO.imageColumnFeed(
                     col.slice(start, length), h, w, channelOrder=order,
-                    dtype=feed_dtype)
+                    dtype=feed_dtype, fused=fused, native_ok=ok,
+                    uniform=uniform)
 
             return decode
+
+        def decoder_spec(batch: pa.RecordBatch):
+            # Process-backend eligibility (SPARKDL_DECODE_BACKEND=process):
+            # per-chunk picklable tasks — the module-level factory plus a
+            # COMPACTED Arrow slice (concat_arrays truncates the buffers;
+            # a bare slice would pickle the whole partition per chunk).
+            col = batch.column(in_col)
+            h, w, feed_dtype = feed_params(col)
+            dtype_name = np.dtype(feed_dtype).name
+            native = chunk_verdicts(col, batch.num_rows, h, w)
+
+            def spec(start: int, length: int) -> tuple:
+                # the pool child re-derives the (cheap) uniform scan from
+                # the compacted chunk; only the budget VERDICT — parent
+                # state — ships in the payload
+                chunk = pa.concat_arrays([col.slice(start, length)])
+                return ingest.decode_image_chunk, \
+                    (chunk, h, w, order, dtype_name, fused,
+                     native.get(start, (False, None))[0])
+
+            return spec
 
         # Each device chunk converts to its FINAL Arrow representation on
         # the scorer's overlap worker as it lands — the float32 model
@@ -202,7 +357,7 @@ class XlaImageTransformer(PicklesCallableParams, Transformer, HasInputCol,
         on_error = self.getOnError()
         scorer = StreamScorer(runner, out_col, make_decoder, encode,
                               empty_array, chunk_rows=batch_size,
-                              on_error=on_error)
+                              on_error=on_error, decoder_spec=decoder_spec)
         # Dead letters of the most recent materialized transform, read
         # back through HasOnError.deadLetters() after collect().
         self._quarantine_sink = scorer.sink

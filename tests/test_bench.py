@@ -114,8 +114,14 @@ def test_all_metric_legs_run_end_to_end_tiny_cpu():
     assert not errs, {k: extra[k] for k in errs}
     for key in ("mfu", "featurizer_rows_per_sec", "featurizer_breakdown",
                 "inference", "bert_tokens_s_chip", "gen_e2e_tokens_s",
-                "flash"):
+                "flash", "host_ingest"):
         assert key in extra, f"leg output missing {key}: {sorted(extra)}"
+    # backend-free ingest leg (ISSUE 7): a real host-side number with
+    # before/after deltas — the record that survives TPU outages
+    hi = extra["host_ingest"]
+    assert hi["value"] > 0 and hi["legs"]["f32_host"]["rows_per_sec"] > 0
+    assert hi["deltas"]["rows_per_sec_vs_f32_host"] >= 2.0, hi["deltas"]
+    assert hi["deltas"]["wire_bytes_ratio_f32_over_u8"] >= 4.0, hi["deltas"]
     # the inference-throughput record (ISSUE 3): rate + per-stage spans
     assert extra["inference"]["rows_per_sec"] > 0
     assert {"decode", "dispatch", "fetch", "encode"} <= \

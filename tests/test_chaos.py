@@ -290,13 +290,17 @@ def test_train_resume_smoke_script(tmp_path):
 
 @pytest.mark.slow
 def test_obs_smoke_script(tmp_path):
-    """scripts/obs_smoke.py end-to-end (ISSUE 2 + ISSUE 6 satellites): a
-    real CPU fit under the supervisor with the flight recorder on and one
-    injected preemption — the merged gang-timeline postmortem must name
-    the faulted rank and site; then a streamed-scoring run with the live
-    telemetry plane armed — a snapshot file must appear MID-run and the
-    bottleneck report must name the expected host-side stage (decode)
-    with internally consistent busy fractions."""
+    """scripts/obs_smoke.py end-to-end (ISSUE 2 + ISSUE 6 + ISSUE 7
+    satellites): a real CPU fit under the supervisor with the flight
+    recorder on and one injected preemption — the merged gang-timeline
+    postmortem must name the faulted rank and site; then a
+    streamed-scoring run with the live telemetry plane armed — a
+    snapshot file must appear MID-run and the bottleneck report must
+    name the expected host-side stage (decode) with internally
+    consistent busy fractions; finally a REAL image-scoring run whose
+    Arrow decode was the pre-ISSUE-7 bottleneck — post-PR the report
+    must NOT name decode dominant (the fused zero-copy feed collapsed
+    it)."""
     proc = subprocess.run(
         [sys.executable, os.path.join(_REPO, "scripts", "obs_smoke.py")],
         capture_output=True, text=True, timeout=420,

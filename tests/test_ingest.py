@@ -1,0 +1,280 @@
+"""Host-ingest layer unit tests (ISSUE 7): zero-copy NHWC column views,
+the fused feed policy, pooled staging buffers, and the shared
+chunk-decode protocol — all jax-free (`core/ingest.py` must stay
+importable and benchmarkable without a backend).
+
+The scorer-level integration (process decode backend, quarantine
+equivalence, chaos across the pool boundary) lives in test_streaming.py;
+this file pins the building blocks.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from sparkdl_tpu.core import ingest
+from sparkdl_tpu.image import imageIO
+
+
+def image_column(n=6, h=4, w=5, seed=0):
+    rng = np.random.default_rng(seed)
+    imgs = [rng.integers(0, 256, (h, w, 3), np.uint8) for _ in range(n)]
+    structs = [imageIO.imageArrayToStruct(im, origin=f"m{i}")
+               for i, im in enumerate(imgs)]
+    return pa.array(structs, type=imageIO.imageSchema), imgs
+
+
+# ---------------------------------------------------------------------------
+# imageColumnNHWCView — the zero-copy fast path
+# ---------------------------------------------------------------------------
+
+def test_nhwc_view_matches_packed_and_is_zero_copy():
+    col, _ = image_column()
+    view = imageIO.imageColumnNHWCView(col)
+    assert view is not None and view.dtype == np.uint8
+    # at-rest layout is BGR: the packed BGR batch is the ground truth
+    packed = imageIO.imageColumnToNHWC(col, 4, 5, dtype=np.uint8,
+                                       channelOrder="BGR")
+    np.testing.assert_array_equal(view, packed)
+    # genuinely a view: read-only, aliasing the Arrow values buffer
+    assert not view.flags.writeable
+    assert view.base is not None
+
+
+def test_nhwc_view_respects_slices():
+    col, _ = image_column(n=8)
+    full = imageIO.imageColumnNHWCView(col)
+    part = imageIO.imageColumnNHWCView(col.slice(3, 4))
+    np.testing.assert_array_equal(part, full[3:7])
+
+
+def test_nhwc_view_declines_nonuniform_columns():
+    rng = np.random.default_rng(1)
+    structs = [imageIO.imageArrayToStruct(
+        rng.integers(0, 256, (h, 4, 3), np.uint8)) for h in (4, 4, 6)]
+    col = pa.array(structs, type=imageIO.imageSchema)
+    assert imageIO.imageColumnNHWCView(col) is None      # mixed heights
+    col2, _ = image_column(n=3)
+    with_null = pa.concat_arrays(
+        [col2, pa.array([None], type=imageIO.imageSchema)])
+    assert imageIO.imageColumnNHWCView(with_null) is None  # null row
+
+
+# ---------------------------------------------------------------------------
+# imageColumnFeed — the fused feed policy
+# ---------------------------------------------------------------------------
+
+def test_feed_fused_ships_native_u8_view_when_upscaling():
+    col, _ = image_column(h=4, w=5)
+    out = imageIO.imageColumnFeed(col, 8, 8, fused=True)
+    assert out.dtype == np.uint8 and out.shape == (6, 4, 5, 3)
+    np.testing.assert_array_equal(out, imageIO.imageColumnNHWCView(col))
+
+
+def test_feed_fused_packs_when_stored_exceeds_target():
+    # downsampling on device would INFLATE wire bytes — pack at target,
+    # still BGR (the device prologue owns the flip in fused mode)
+    col, _ = image_column(h=8, w=8)
+    out = imageIO.imageColumnFeed(col, 4, 4, dtype=np.float32, fused=True)
+    assert out.dtype == np.float32 and out.shape == (6, 4, 4, 3)
+    np.testing.assert_array_equal(
+        out, imageIO.imageColumnToNHWC(col, 4, 4, dtype=np.float32,
+                                       channelOrder="BGR"))
+
+
+def test_feed_legacy_path_packs_on_host():
+    col, _ = image_column(h=4, w=5)
+    out = imageIO.imageColumnFeed(col, 8, 8, dtype=np.float32,
+                                  channelOrder="RGB", fused=False)
+    np.testing.assert_array_equal(
+        out, imageIO.imageColumnToNHWC(col, 8, 8, dtype=np.float32,
+                                       channelOrder="RGB"))
+
+
+def test_fused_preprocess_env_gate(monkeypatch):
+    assert ingest.fused_preprocess_default() is True
+    monkeypatch.setenv("SPARKDL_FUSED_PREPROCESS", "0")
+    assert ingest.fused_preprocess_default() is False
+
+
+# ---------------------------------------------------------------------------
+# StagingPool + stage_batch — reused pad/put host buffers
+# ---------------------------------------------------------------------------
+
+def test_stage_batch_full_batch_passes_through():
+    pool = ingest.StagingPool()
+    arr = np.arange(12, dtype=np.float32).reshape(4, 3)
+    staged, n, lease, copied = ingest.stage_batch(arr, 4, pool)
+    assert staged is arr and n == 4 and lease is None and copied == 0
+    assert pool.stats() == {"allocs": 0, "reuses": 0}
+
+
+def test_stage_batch_pads_and_reuses_buffers():
+    pool = ingest.StagingPool()
+    a = np.arange(6, dtype=np.float32).reshape(2, 3)
+    staged, n, lease, copied = ingest.stage_batch(a, 4, pool)
+    assert n == 2 and copied == staged.nbytes
+    np.testing.assert_array_equal(staged[:2], a)
+    np.testing.assert_array_equal(staged[2:], np.broadcast_to(a[:1], (2, 3)))
+    pool.release(lease)
+    # same (shape, dtype) → the SAME buffer comes back, no new alloc
+    staged2, _, lease2, _ = ingest.stage_batch(
+        np.ones((3, 3), np.float32), 4, pool)
+    assert staged2 is staged
+    assert pool.stats() == {"allocs": 1, "reuses": 1}
+    pool.release(lease2)
+
+
+def test_stage_batch_dict_batches_and_oversize():
+    pool = ingest.StagingPool()
+    batch = {"a": np.zeros((2, 3), np.float32),
+             "b": np.ones((2, 2), np.int32)}
+    staged, n, lease, copied = ingest.stage_batch(batch, 4, pool)
+    assert n == 2 and len(lease) == 2
+    assert copied == sum(v.nbytes for v in staged.values())
+    assert staged["a"].shape == (4, 3) and staged["b"].shape == (4, 2)
+    pool.release(lease)
+    with pytest.raises(ValueError, match="exceeds"):
+        ingest.stage_batch(np.zeros((5, 3), np.float32), 4, pool)
+
+
+def test_stage_buffers_env_gate(monkeypatch):
+    assert ingest.stage_buffers_default() is True
+    monkeypatch.setenv("SPARKDL_STAGE_BUFFERS", "0")
+    assert ingest.stage_buffers_default() is False
+
+
+# ---------------------------------------------------------------------------
+# decode_chunk — the ONE copy of chunk-then-row-fallback semantics
+# ---------------------------------------------------------------------------
+
+def _flaky_decoder(bad):
+    def decode(start, length):
+        rows = range(start, start + length)
+        if any(r in bad for r in rows):
+            raise ValueError(f"bad row in {list(rows)}")
+        return np.full((length, 2), float(start), np.float32)
+    return decode
+
+
+def test_decode_chunk_clean_and_raise_modes():
+    arr, info = ingest.decode_chunk(_flaky_decoder(set()), 0, 4, True)
+    assert arr.shape == (4, 2) and info == {"length": 4, "dead": []}
+    with pytest.raises(ValueError):
+        ingest.decode_chunk(_flaky_decoder({1}), 0, 4, False)
+
+
+def test_decode_chunk_row_fallback_dead_letters():
+    arr, info = ingest.decode_chunk(_flaky_decoder({1, 3}), 0, 4, True)
+    assert arr.shape == (2, 2)
+    assert [d[0] for d in info["dead"]] == [1, 3]
+    assert all(d[1] == "ValueError" for d in info["dead"])
+
+
+def test_decode_backend_env_resolution(monkeypatch):
+    monkeypatch.delenv("SPARKDL_DECODE_BACKEND", raising=False)
+    assert ingest.decode_backend_default() == "thread"
+    monkeypatch.setenv("SPARKDL_DECODE_BACKEND", "process")
+    assert ingest.decode_backend_default() == "process"
+    monkeypatch.setenv("SPARKDL_DECODE_BACKEND", "bogus")
+    assert ingest.decode_backend_default() == "thread"
+
+
+def test_pool_not_rebuilt_while_held():
+    """A concurrent stream's mismatched worker request must ride the
+    HELD pool, never tear it down (cancelling the holder's in-flight
+    futures outside the quarantine protocol); the rebuild happens at the
+    next unheld request."""
+    ingest.shutdown_decode_executor()
+    try:
+        a = ingest.acquire_decode_executor(1)
+        assert ingest.get_decode_executor(2) is a      # held: no rebuild
+        assert ingest.acquire_decode_executor(2) is a  # even acquiring
+        ingest.release_decode_executor()
+        ingest.release_decode_executor()
+        b = ingest.get_decode_executor(2)              # unheld: rebuilt
+        assert b is not a
+    finally:
+        ingest.shutdown_decode_executor()
+
+
+def test_broken_pool_is_replaced():
+    """A BrokenProcessPool executor is poisoned permanently — caching it
+    would fail every later process-backend stream until the interpreter
+    restarts. A broken pool must be replaced on the next request, even
+    while nominally held."""
+    ingest.shutdown_decode_executor()
+    try:
+        a = ingest.get_decode_executor(1)
+        a._broken = "child died"
+        b = ingest.get_decode_executor(1)  # same key, but broken → new
+        assert b is not a
+        c = ingest.acquire_decode_executor(1)
+        assert c is b
+        c._broken = "child died"
+        d = ingest.acquire_decode_executor(1)  # held AND broken → new
+        assert d is not c
+        ingest.release_decode_executor()
+        ingest.release_decode_executor()
+    finally:
+        ingest.shutdown_decode_executor()
+
+
+def test_stalled_pool_is_evicted_even_while_held():
+    """A stall means a wedged-but-ALIVE child: it never sets _broken, so
+    without explicit eviction the pool would keep its lost worker slot
+    until interpreter restart and every retry would re-stall. After
+    invalidate_decode_executor the next request — even from the same
+    holder — gets a fresh pool; invalidating a pool no longer in the
+    slot is a no-op."""
+    ingest.shutdown_decode_executor()
+    try:
+        a = ingest.acquire_decode_executor(1)
+        ingest.invalidate_decode_executor(a)
+        b = ingest.acquire_decode_executor(1)
+        assert b is not a
+        ingest.invalidate_decode_executor(a)  # stale handle: no-op
+        assert ingest.get_decode_executor(1) is b
+        ingest.release_decode_executor()
+        ingest.release_decode_executor()
+    finally:
+        ingest.shutdown_decode_executor()
+
+
+def test_decode_stall_resolution_precedence(monkeypatch):
+    """SPARKDL_DISPATCH_TIMEOUT_S takes precedence whenever SET —
+    including an explicit 0, that knob's documented off value, which
+    must actually disable the decode watchdog instead of falling
+    through a falsy-or to the 600s default."""
+    monkeypatch.delenv("SPARKDL_DISPATCH_TIMEOUT_S", raising=False)
+    monkeypatch.delenv("SPARKDL_DECODE_TIMEOUT_S", raising=False)
+    assert ingest.decode_stall_resolved() == 600.0
+    monkeypatch.setenv("SPARKDL_DECODE_TIMEOUT_S", "120")
+    assert ingest.decode_stall_resolved() == 120.0
+    monkeypatch.setenv("SPARKDL_DISPATCH_TIMEOUT_S", "30")
+    assert ingest.decode_stall_resolved() == 30.0
+    monkeypatch.setenv("SPARKDL_DISPATCH_TIMEOUT_S", "0")
+    assert ingest.decode_stall_resolved() == 0.0
+    monkeypatch.setenv("SPARKDL_DISPATCH_TIMEOUT_S", "bogus")
+    assert ingest.decode_stall_resolved() == 120.0
+
+
+def test_windowed_apply_stall_watchdog():
+    """stall_s arms a decode-future watchdog: a worker that never
+    completes (the fork-deadlock hazard) raises a classified
+    ScoringStallError instead of hanging the stream forever."""
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    from sparkdl_tpu.runner.failures import ScoringStallError
+    release = threading.Event()
+    ex = ThreadPoolExecutor(max_workers=1)
+    try:
+        g = ingest.windowed_apply(lambda x: release.wait(30), [1], 1, 1,
+                                  executor=ex, stall_s=0.2,
+                                  stall_stage="decode")
+        with pytest.raises(ScoringStallError, match="decode"):
+            next(g)
+    finally:
+        release.set()
+        ex.shutdown(wait=False)

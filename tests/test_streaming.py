@@ -420,3 +420,284 @@ def test_circuit_breaker_has_min_rows_floor():
     assert t.deadLetters().num_rows == 4
     from sparkdl_tpu.runner import metrics
     metrics.run_stats.reset()
+
+
+# ---------------------------------------------------------------------------
+# Process decode backend (ISSUE 7): SPARKDL_DECODE_BACKEND=process
+# ---------------------------------------------------------------------------
+
+def test_process_backend_vector_equivalence(monkeypatch):
+    """The process decode pool is a drop-in for threads: same outputs,
+    same order, across many partitions including filter-emptied ones."""
+    df, vals = vector_df(37, parts=9)
+    t = sdl.XlaTransformer(inputCol="x", outputCol="y",
+                           fn=lambda b: b * 2.0 + 1.0, batchSize=4)
+    thread = np.asarray([r.y for r in t.transform(df).collect()],
+                        np.float32)
+    monkeypatch.setenv("SPARKDL_DECODE_BACKEND", "process")
+    got = np.asarray([r.y for r in t.transform(df).collect()], np.float32)
+    np.testing.assert_array_equal(got, thread)
+
+    emptied = df.filter(lambda r: abs(r.x[0]) < 0.7)
+    rows = t.transform(emptied).collect()
+    assert len(rows) == sum(1 for v in vals if abs(v[0]) < 0.7)
+
+
+def test_process_backend_image_equivalence(monkeypatch):
+    """Image path (compacted Arrow chunk payloads over the pickle
+    boundary): bit-identical to the thread backend."""
+    rng = np.random.default_rng(3)
+    imgs = [rng.integers(0, 256, (8, 8, 3), np.uint8) for _ in range(10)]
+    structs = [imageIO.imageArrayToStruct(im, origin=f"m{i}")
+               for i, im in enumerate(imgs)]
+    df = sdl.DataFrame.fromArrow(
+        pa.table({"image": pa.array(structs, type=imageIO.imageSchema)}),
+        numPartitions=3)
+    t = sdl.XlaImageTransformer(
+        inputCol="image", outputCol="out", fn=lambda b: b.mean(axis=(1, 2)),
+        inputSize=(8, 8), batchSize=4)
+    thread = np.asarray([r.out for r in t.transform(df).collect()])
+    monkeypatch.setenv("SPARKDL_DECODE_BACKEND", "process")
+    got = np.asarray([r.out for r in t.transform(df).collect()])
+    np.testing.assert_array_equal(got, thread)
+
+
+def test_process_backend_quarantine_equivalence(monkeypatch):
+    """PR 4 fault tolerance on the process backend: the row-fallback runs
+    in the pool child, dead-letter rows re-base onto the partition, and
+    counts/classes/survivors match the thread backend exactly."""
+    from sparkdl_tpu.runner import metrics
+    metrics.run_stats.reset()
+    df, rows = ragged_df()
+    t = quarantining_transformer()
+    thread_out = t.transform(df).collect()
+    thread_dead = t.deadLetters()
+    monkeypatch.setenv("SPARKDL_DECODE_BACKEND", "process")
+    out = t.transform(df).collect()
+    dead = t.deadLetters()
+    assert len(out) == len(thread_out) == 14
+    assert dead.num_rows == thread_dead.num_rows == 2
+    assert dead.column("error_class").to_pylist() == \
+        thread_dead.column("error_class").to_pylist()
+    # dead letters carry the ORIGINAL payloads of exactly the bad rows
+    assert sorted(len(v) for v in dead.column("x").to_pylist()) == [1, 1]
+    np.testing.assert_array_equal(
+        np.asarray([r.y for r in out], np.float32),
+        np.asarray([r.y for r in thread_out], np.float32))
+    metrics.run_stats.reset()
+
+
+def test_process_backend_chaos_decode_all_rows_dead(monkeypatch):
+    """Chaos ``decode`` fires IN THE POOL CHILD (the plan ships with each
+    task): prob=1/once=False fails every chunk and every row-fallback
+    attempt, so the whole input quarantines and the circuit breaker
+    trips — deterministic proof the site is live across the process
+    boundary."""
+    from sparkdl_tpu.runner import chaos, metrics
+    from sparkdl_tpu.runner.failures import QuarantineOverflowError
+    monkeypatch.setenv("SPARKDL_DECODE_BACKEND", "process")
+    df, _ = ragged_df(bad_rows=())
+    t = quarantining_transformer()
+    chaos.install(chaos.FaultPlan(
+        [chaos.Fault("decode", "fatal", prob=1.0, once=False)]))
+    try:
+        with pytest.raises(QuarantineOverflowError):
+            t.transform(df).collect()
+    finally:
+        chaos.uninstall()
+        metrics.run_stats.reset()
+
+
+def test_process_backend_chaos_once_semantics(tmp_path, monkeypatch):
+    """once=True with a plan ``state_dir`` holds ACROSS pool children
+    (marker files, exactly like supervised gang restarts): one chunk
+    fails and row-recovers, everything else decodes clean — full output,
+    zero dead letters."""
+    from sparkdl_tpu.runner import chaos, metrics
+    monkeypatch.setenv("SPARKDL_DECODE_BACKEND", "process")
+    df, rows = ragged_df(bad_rows=())
+    t = quarantining_transformer()
+    chaos.install(chaos.FaultPlan(
+        [chaos.Fault("decode", "fatal", prob=1.0, once=True)],
+        state_dir=str(tmp_path)))
+    try:
+        out = t.transform(df).collect()
+        assert len(out) == 16
+        assert t.deadLetters().num_rows == 0
+        # the once-marker landed exactly once, from whichever child fired
+        assert [f for f in os.listdir(tmp_path) if f.endswith(".fired")]
+    finally:
+        chaos.uninstall()
+        metrics.run_stats.reset()
+
+
+def test_process_backend_workers0_inline(monkeypatch):
+    """workers=0 under SPARKDL_DECODE_BACKEND=process still maps inline
+    on the consumer thread (no pool of either kind) with correct output."""
+    monkeypatch.setenv("SPARKDL_DECODE_BACKEND", "process")
+    monkeypatch.setenv("SPARKDL_DECODE_WORKERS", "0")
+    df, vals = vector_df(11, parts=3)
+    t = sdl.XlaTransformer(inputCol="x", outputCol="y",
+                           fn=lambda b: b * 2.0 + 1.0, batchSize=4)
+    got = np.asarray([r.y for r in t.transform(df).collect()], np.float32)
+    np.testing.assert_allclose(got, vals * 2.0 + 1.0, rtol=1e-6)
+
+
+def test_process_backend_without_spec_degrades_to_threads(
+        monkeypatch, caplog):
+    """A scorer with no decoder_spec (decoder closes over un-picklable
+    state) must WARN and decode on threads, not crash the stream."""
+    import logging
+
+    from sparkdl_tpu.transformers import streaming as streaming_mod
+    orig_init = streaming_mod.StreamScorer.__init__
+
+    def no_spec_init(self, *a, **kw):
+        kw.pop("decoder_spec", None)
+        orig_init(self, *a, **kw)
+
+    monkeypatch.setattr(streaming_mod.StreamScorer, "__init__",
+                        no_spec_init)
+    monkeypatch.setenv("SPARKDL_DECODE_BACKEND", "process")
+    df, vals = vector_df(9, parts=2)
+    t = sdl.XlaTransformer(inputCol="x", outputCol="y",
+                           fn=lambda b: b + 1.0, batchSize=4)
+    with caplog.at_level(logging.WARNING, logger="sparkdl_tpu.streaming"):
+        got = np.asarray([r.y for r in t.transform(df).collect()],
+                         np.float32)
+    np.testing.assert_allclose(got, vals + 1.0, rtol=1e-6)
+    assert any("decoder_spec" in r.message for r in caplog.records)
+
+
+# ---------------------------------------------------------------------------
+# Fused-feed policy regressions (ISSUE 7 review round)
+# ---------------------------------------------------------------------------
+
+def _image_df(imgs, parts=1):
+    structs = [imageIO.imageArrayToStruct(im, origin=f"m{i}")
+               for i, im in enumerate(imgs)]
+    return sdl.DataFrame.fromArrow(
+        pa.table({"image": pa.array(structs, type=imageIO.imageSchema)}),
+        numPartitions=parts)
+
+
+def test_fused_feed_requires_static_input_size():
+    """No ``inputSize`` → target pinned per partition at decode time,
+    which the once-traced prologue cannot know: fused mode must stand
+    down to the host pack path. Regression: a mixed-size partition whose
+    later chunk is uniformly SMALLER than the pinned target used to ship
+    at native size with nothing ever resizing it."""
+    rng = np.random.default_rng(5)
+    imgs = [rng.integers(0, 256, (16, 16, 3), np.uint8) for _ in range(8)]
+    imgs += [rng.integers(0, 256, (8, 8, 3), np.uint8) for _ in range(4)]
+    df = _image_df(imgs, parts=1)
+    t = sdl.XlaImageTransformer(inputCol="image", outputCol="f",
+                                fn=lambda b: b.mean(axis=(1, 2)),
+                                batchSize=4)  # chunk 2 is uniform 8x8
+    got = np.asarray([r.f for r in t.transform(df).collect()])
+    assert got.shape == (12, 3)
+    # reference: every row host-packed to the partition-pinned 16x16
+    expect = imageIO.imageColumnToNHWC(
+        pa.array([imageIO.imageArrayToStruct(im) for im in imgs],
+                 type=imageIO.imageSchema), 16, 16, dtype=np.uint8,
+        channelOrder="RGB").astype(np.float32).mean(axis=(1, 2))
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-4)
+
+
+@pytest.mark.parametrize("backend", ["thread", "process"])
+def test_fused_row_fallback_keeps_mixed_size_rows(monkeypatch, backend):
+    """Quarantine row-fallback under the fused feed: a chunk mixing
+    stored sizes (all <= target) plus ONE corrupt row must dead-letter
+    exactly the corrupt row — the 1-row re-decodes pack at target, so
+    valid minority-size rows can't deviate from the modal shape."""
+    from sparkdl_tpu.runner import metrics
+    monkeypatch.setenv("SPARKDL_DECODE_BACKEND", backend)
+    rng = np.random.default_rng(6)
+    structs = []
+    for i in range(8):
+        edge = 8 if i % 2 else 6  # mixed sizes -> no zero-copy view
+        structs.append(imageIO.imageArrayToStruct(
+            rng.integers(0, 256, (edge, edge, 3), np.uint8),
+            origin=f"m{i}"))
+    structs[3] = dict(structs[3], data=b"\x00" * 5)  # corrupt payload
+    df = sdl.DataFrame.fromArrow(
+        pa.table({"image": pa.array(structs, type=imageIO.imageSchema)}),
+        numPartitions=1)
+    t = sdl.XlaImageTransformer(inputCol="image", outputCol="f",
+                                fn=lambda b: b.mean(axis=(1, 2)),
+                                inputSize=(16, 16), batchSize=8,
+                                onError="quarantine")
+    out = t.transform(df).collect()
+    dead = t.deadLetters()
+    assert len(out) == 7
+    assert dead.num_rows == 1
+    assert [r["origin"] for r in dead.column("image").to_pylist()] == ["m3"]
+    metrics.run_stats.reset()
+
+
+@pytest.mark.parametrize("backend", ["thread", "process"])
+def test_wire_shape_cap_bounds_native_sizes(monkeypatch, backend):
+    """SPARKDL_MAX_WIRE_SHAPES: each distinct native size a fused stage
+    ships is one XLA compilation, so past the cap chunks must pack at the
+    target shape. Cap=1 + three uniform-size runs → exactly one native
+    size on the wire, correct outputs for all rows."""
+    monkeypatch.setenv("SPARKDL_DECODE_BACKEND", backend)
+    monkeypatch.setenv("SPARKDL_MAX_WIRE_SHAPES", "1")
+    rng = np.random.default_rng(9)
+    imgs = [rng.integers(0, 256, (e, e, 3), np.uint8)
+            for e in (6, 6, 8, 8, 10, 10)]  # 3 uniform-size chunk runs
+    df = _image_df(imgs, parts=1)
+    t = sdl.XlaImageTransformer(inputCol="image", outputCol="f",
+                                fn=lambda b: b.mean(axis=(1, 2)),
+                                inputSize=(16, 16), batchSize=2)
+    events.reset()
+    got = np.asarray([r.f for r in t.transform(df).collect()])
+    assert got.shape == (6, 3)
+    # the wire evidence is the put spans' byte ledger: u8 feeds of
+    # (2,6,6,3)/(2,8,8,3)/(2,10,10,3) vs packed target (2,16,16,3)
+    put_bytes = sorted(e["bytes"] for e in events.get_recorder().ring
+                       if e["name"] == "put" and e["ph"] == "E")
+    native = [b for b in put_bytes if b < 2 * 16 * 16 * 3]
+    assert len(native) == 1, put_bytes  # only the FIRST size went native
+
+
+@pytest.mark.parametrize("backend", ["thread", "process"])
+def test_wire_budget_not_stranded_on_undeliverable_chunk(monkeypatch,
+                                                         backend):
+    """A chunk that is metadata-uniform but whose zero-copy view DECLINES
+    (truncated payload fails the offsets check) must not consume a
+    wire-shape budget slot: with cap=1, a later legitimately shippable
+    size still goes native instead of finding the budget stranded on a
+    shape that only ever packs."""
+    from sparkdl_tpu.runner import metrics
+    monkeypatch.setenv("SPARKDL_DECODE_BACKEND", backend)
+    monkeypatch.setenv("SPARKDL_MAX_WIRE_SHAPES", "1")
+    rng = np.random.default_rng(11)
+    structs = [imageIO.imageArrayToStruct(
+        rng.integers(0, 256, (8, 8, 3), np.uint8), origin=f"a{i}")
+        for i in range(4)]
+    # metadata says (8, 8, 3) but the payload is truncated: uniform-size
+    # scan passes, the view's row-bytes check declines, the pack raises
+    # -> row-fallback dead-letters exactly this row
+    structs[1] = dict(structs[1], data=b"\x00" * 5)
+    structs += [imageIO.imageArrayToStruct(
+        rng.integers(0, 256, (6, 6, 3), np.uint8), origin=f"b{i}")
+        for i in range(4)]
+    df = sdl.DataFrame.fromArrow(
+        pa.table({"image": pa.array(structs, type=imageIO.imageSchema)}),
+        numPartitions=1)
+    t = sdl.XlaImageTransformer(inputCol="image", outputCol="f",
+                                fn=lambda b: b.mean(axis=(1, 2)),
+                                inputSize=(16, 16), batchSize=4,
+                                onError="quarantine")
+    events.reset()
+    out = t.transform(df).collect()
+    assert len(out) == 7
+    assert [r["origin"] for r in
+            t.deadLetters().column("image").to_pylist()] == ["a1"]
+    # the clean 6x6 chunk must hold the one budget slot: its put ships
+    # the native (4, 6, 6, 3) u8 view, not the packed (4, 16, 16, 3)
+    put_bytes = sorted(e["bytes"] for e in events.get_recorder().ring
+                       if e["name"] == "put" and e["ph"] == "E")
+    assert 4 * 6 * 6 * 3 in put_bytes, put_bytes
+    metrics.run_stats.reset()

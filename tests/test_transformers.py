@@ -60,13 +60,17 @@ def test_xla_image_transformer_streams_decode_per_chunk(monkeypatch):
     transform op must never materialize more rows than batchSize at once,
     however large the partition (round-1 verdict weak #4)."""
     seen = []
-    orig = imageIO.imageColumnToNHWC
+    orig = imageIO.imageColumnFeed
 
     def spy(column, *a, **kw):
         seen.append(len(column))
         return orig(column, *a, **kw)
 
-    monkeypatch.setattr(imageIO, "imageColumnToNHWC", spy)
+    monkeypatch.setattr(imageIO, "imageColumnFeed", spy)
+    # the spy must observe every decode: pin the thread backend (a
+    # process-pool child's calls would be invisible to the parent's spy;
+    # the chunking invariant itself is backend-independent)
+    monkeypatch.setenv("SPARKDL_DECODE_BACKEND", "thread")
     df, _ = image_df(n=40, h=8, w=8, parts=1)  # one big partition
     t = sdl.XlaImageTransformer(inputCol="image", outputCol="feat",
                                 fn=lambda b: jnp.mean(b, axis=(1, 2)),
@@ -126,16 +130,20 @@ def test_deep_image_featurizer_resnet18_and_persistence(tmp_path):
     assert feats.shape == (4, 512)
     assert f.featureDim() == 512
 
-    # equivalence: direct jitted apply on the resized batch. The transform
-    # feed path resizes into uint8 before shipping to the device (round-3
-    # perf fix), so the reference decodes the same way: uint8 then cast.
+    # equivalence: direct jitted apply mirroring the fused feed (ISSUE 7).
+    # The transform ships the native-size uint8 batch and the compiled
+    # prologue does cast → BGR→RGB flip → jax.image.resize on device, so
+    # the reference decodes at native size (exact: pack + flip, no host
+    # resize) and resizes the same way.
     m = get_model("ResNet18")
     variables = f._load_variables()
-    nhwc = imageIO.structsToNHWC(
-        [imageIO.imageArrayToStruct(im) for im in imgs], 224, 224,
+    native = imageIO.structsToNHWC(
+        [imageIO.imageArrayToStruct(im) for im in imgs], 40, 40,
         dtype=np.uint8).astype(np.float32)
+    resized = jax.image.resize(
+        jnp.asarray(native), (len(imgs), 224, 224, 3), method="bilinear")
     direct = np.asarray(jax.jit(m.apply_fn(features_only=True))(
-        variables, nhwc))
+        variables, resized))
     np.testing.assert_allclose(feats, direct, rtol=2e-4, atol=2e-4)
 
     # persistence: weights travel with the transformer
