@@ -1082,15 +1082,44 @@ def _worker_host_ingest() -> dict:
     trajectory number through ``backend_unavailable`` stretches. The
     record embeds the pre-ISSUE-7 feed (``legs.f32_host``) next to the
     new default (``legs.u8_fused``) — before/after on the same workload."""
-    import importlib.util
-    spec = importlib.util.spec_from_file_location(
-        "ingest_bench", os.path.join(_HERE, "scripts", "ingest_bench.py"))
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
     # Default NOT divisible by the 64-row bench batch: the tail chunk is
     # what exercises the StagingPool (see scripts/ingest_bench.py).
     rows = int(os.environ.get("BENCH_INGEST_ROWS", "1000"))
-    return mod.run(rows=rows)
+    return _load_script_module("ingest_bench.py").run(rows=rows)
+
+
+def _load_script_module(name: str):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        name.replace(".py", ""), os.path.join(_HERE, "scripts", name))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _worker_serve() -> dict:
+    """Continuous-batching serving leg (ISSUE 8): aggregate tokens/s at
+    closed-loop concurrency 1/8/32 through ``serving.GenerationEngine``
+    vs the static whole-batch ``generate()`` path on the same workload,
+    with latency percentiles from the telemetry histograms and the
+    no-decode-retrace pin (``scripts/serve_bench.py``).
+    ``BENCH_SERVE_FORCE_CPU=1`` (set by the backend-outage path) pins
+    ``JAX_PLATFORMS=cpu`` — the batching win is a *scheduling* property,
+    measurable on any live jax backend, so the record carries a real
+    engine-vs-static ratio even when the TPU is down."""
+    if os.environ.get("BENCH_SERVE_FORCE_CPU"):
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    else:
+        _apply_platform_env()
+    return _load_script_module("serve_bench.py").run(mode="llama")
+
+
+def _worker_serve_stub() -> dict:
+    """Scheduler-only serving leg on the jax-free ``StubBackend`` with a
+    synthetic per-step device time — queue/slot mechanics and the
+    batching win stay measured inside a ``backend_unavailable`` record
+    (the same never-host-blind rule as the host-ingest leg)."""
+    return _load_script_module("serve_bench.py").run(mode="stub")
 
 
 _WORKERS = {"resnet50_train": _worker_resnet50_train,
@@ -1099,6 +1128,8 @@ _WORKERS = {"resnet50_train": _worker_resnet50_train,
             "bert_train": _worker_bert_train,
             "flash": _worker_flash,
             "generate": _worker_generate,
+            "serve": _worker_serve,
+            "serve_stub": _worker_serve_stub,
             "northstar": _worker_northstar,
             "probe": _worker_probe}
 
@@ -1358,6 +1389,29 @@ def main():
             err_extra["host_ingest"] = ingest_rec
         elif ingest_err:
             err_extra["host_ingest_error"] = ingest_err
+        # The serving leg rides the outage record too (ISSUE 8 satellite,
+        # same never-host-blind rule): the stub leg measures scheduler
+        # throughput with zero jax, and the llama leg re-runs the full
+        # engine-vs-static comparison pinned to the CPU backend.
+        if os.environ.get("BENCH_SKIP_SERVE"):
+            serve_stub, stub_err = None, {"kind": "skipped",
+                                          "detail": "env"}
+            serve_rec, serve_err = None, {"kind": "skipped",
+                                          "detail": "env"}
+        else:
+            serve_stub, stub_err = _run_worker("serve_stub",
+                                               probe_timeout, 0, budget)
+            os.environ["BENCH_SERVE_FORCE_CPU"] = "1"
+            serve_rec, serve_err = _run_worker(
+                "serve", max(probe_timeout, 420.0), 0, budget)
+        if serve_stub:
+            err_extra["serving_stub"] = serve_stub
+        elif stub_err:
+            err_extra["serving_stub_error"] = stub_err
+        if serve_rec:
+            err_extra["serving"] = serve_rec
+        elif serve_err:
+            err_extra["serving_error"] = serve_err
         err_extra["budget"] = {"wall_s": budget.wall_s,
                                "spent_s": round(budget.spent(), 1),
                                "leg_times_s": dict(budget.leg_times)}
@@ -1403,6 +1457,7 @@ def main():
     flash, flash_err = leg("flash", "BENCH_SKIP_FLASH")
     bert, bert_err = leg("bert_train", "BENCH_SKIP_BERT")
     gen, gen_err = leg("generate", "BENCH_SKIP_GEN")
+    serve, serve_err = leg("serve", "BENCH_SKIP_SERVE")
     # north-star scale leg: opt-in (expensive), LAST so it can only
     # starve itself of budget, never the headline legs
     ns, ns_err = (None, None)
@@ -1443,6 +1498,16 @@ def main():
                       for k, v in gen.items()})
     elif gen_err:
         extra["gen_error"] = gen_err
+    if serve:
+        # The ISSUE 8 record: serve_tokens_s = aggregate engine tokens/s
+        # at the highest measured concurrency, next to the static
+        # whole-batch comparator and the re-trace pin.
+        top = max((serve.get("engine") or {}).items(),
+                  key=lambda kv: int(kv[0]), default=(None, {}))[1]
+        extra["serve_tokens_s"] = top.get("tokens_s")
+        extra["serving"] = serve
+    elif serve_err:
+        extra["serving_error"] = serve_err
     if flash:
         extra["flash"] = flash
     elif flash_err:
@@ -1493,7 +1558,7 @@ def main():
     # run_with_restarts), so the record shows HOW the number was survived.
     fs = {"restarts": budget.restarts, "faults_injected": 0,
           "last_failure_kind": budget.last_failure_kind}
-    for r in (train, feat, flash, bert, gen, ns):
+    for r in (train, feat, flash, bert, gen, serve, ns):
         ws = (r or {}).get("failure_stats") if isinstance(r, dict) else None
         if isinstance(ws, dict):
             fs["restarts"] += int(ws.get("restarts") or 0)

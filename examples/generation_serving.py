@@ -10,6 +10,12 @@ ByteBPETokenizer on a local corpus, then drive a text column through
 registerTextGenerationUDF — string → tokens → generate → string without
 downloading anything.
 
+Part 3 (online serving): the same prompts through the
+continuous-batching engine (sparkdl_tpu.serving) — mixed lengths stream
+through a 2-slot table with in-flight refill, tokens stream per request
+via callback, and greedy output is token-identical to the static
+two-program path of Part 1.
+
 Run: JAX_PLATFORMS=cpu python examples/generation_serving.py
 """
 
@@ -77,6 +83,43 @@ def string_column_serving(model, variables):
           "in-repo tokenizer only.")
 
 
+def continuous_batching_serving(model, variables, cfg):
+    """Part 3: the static path waits for the whole batch; the engine
+    retires and refills each slot independently. Greedy decoding makes
+    the two paths exactly comparable — token-identical per request."""
+    from sparkdl_tpu.models.llama import generate, left_pad_prompts
+    from sparkdl_tpu.serving import GenerationEngine
+
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg.vocab_size, n).tolist()
+               for n in (5, 2, 7, 3, 6)]  # Part 1's prompts
+    engine = GenerationEngine.from_model(model, variables, num_slots=2,
+                                         max_len=64, min_bucket=8)
+    streamed: dict = {}
+    handles = [
+        engine.submit(p, max_new_tokens=8,
+                      stream_cb=lambda r, t:
+                      streamed.setdefault(r.id, []).append(t))
+        for p in prompts]
+    engine.run_until_idle()
+    for p, h in zip(prompts, handles):
+        ids, lens = left_pad_prompts([p])
+        ref = np.asarray(generate(model, variables, ids, 8,
+                                  pad_lens=lens, pad_to=64))[0]
+        want = ref[int(lens[0]) + len(p):].tolist()
+        got = h.result()
+        assert got == want, (p, got, want)
+        # the stream callback saw every token, in emission order
+        assert streamed[h.id] == got
+        print(f"  {p} -> {got}")
+    snap = engine.snapshot()
+    assert snap["completed"] == len(prompts)
+    assert snap["peak_slots_busy"] == 2  # requests genuinely overlapped
+    print(f"5 requests over 2 slots ({snap['steps']} decode iterations, "
+          f"{snap['prefills']} slot prefills): continuous batching is "
+          f"token-identical to the static two-program path.")
+
+
 def main():
     cfg = LlamaConfig.tiny()  # random init — swap in load_pretrained(...)
     model = LlamaModel(cfg)
@@ -84,6 +127,7 @@ def main():
                            np.zeros((1, 4), np.int32))
     token_column_serving(model, variables, cfg)
     string_column_serving(model, variables)
+    continuous_batching_serving(model, variables, cfg)
 
 
 if __name__ == "__main__":

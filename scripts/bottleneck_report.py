@@ -77,6 +77,18 @@ def main(argv=None) -> int:
                   f"max_concurrency {st['max_concurrency']}")
         for name, n in sorted((agg.get("events") or {}).items()):
             print(f"  event {name}: {n}")
+        for name, h in sorted((agg.get("histograms") or {}).items()):
+            # One derivation for everyone: telemetry.histogram_quantile
+            # is the same helper the serving bench uses, so a latency
+            # percentile printed here can never disagree with the bench
+            # on the same snapshot.
+            qs = {q: telemetry.histogram_quantile(h, q)
+                  for q in (0.5, 0.95, 0.99)}
+            if qs[0.5] is None:
+                continue
+            print(f"  {name}: p50 {qs[0.5]:.4g}s  p95 {qs[0.95]:.4g}s  "
+                  f"p99 {qs[0.99]:.4g}s  (n={h.get('count', 0)}, "
+                  f"bucket-resolution)")
     return 0
 
 

@@ -39,6 +39,7 @@ from .transformers import (DeepImageFeaturizer, DeepImagePredictor,
                            XlaImageTransformer, XlaTransformer)
 from .runner import (CheckpointManager, RunnerContext, TrainState, XlaRunner,
                      make_shard_map_step, make_train_step)
+from .serving import GenerationEngine
 from .transformers.feature import (IndexToString, StandardScaler,
                                    StandardScalerModel, StringIndexer,
                                    StringIndexerModel, VectorAssembler)
@@ -78,5 +79,6 @@ __all__ = [
     "flash_attention",
     "XlaRunner", "RunnerContext", "TrainState", "CheckpointManager",
     "make_train_step", "make_shard_map_step",
+    "GenerationEngine",
     "__version__",
 ]

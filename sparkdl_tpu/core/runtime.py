@@ -841,6 +841,14 @@ class CompileCache:
         with self._lock:
             return {"hits": self.hits, "misses": self.misses}
 
+    def signatures(self, name: str) -> int:
+        """How many distinct call signatures ``name`` has compiled — the
+        re-trace observable (the serving bench pins "no decode-step
+        re-trace after warmup" as ``signatures('serve_decode_step')``
+        staying constant across the measured run)."""
+        with self._lock:
+            return len(self._keys.get(name, ()))
+
 
 GLOBAL_COMPILE_CACHE = CompileCache()
 

@@ -136,7 +136,7 @@ class LlamaAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, decode: bool = False, pad_lens=None,
-                 first_chunk: bool = False):
+                 first_chunk: bool = False, slot_cur=None):
         c, d = self.cfg, self.dtype
         B, S, _ = x.shape
         hd = c.head_dim
@@ -200,7 +200,51 @@ class LlamaAttention(nn.Module):
                                     (B, c.num_kv_heads, S, hd), d)
             idx = self.variable("cache", "idx",
                                 lambda: jnp.zeros((), jnp.int32))
-            if not self.is_initializing():
+            if slot_cur is not None and not self.is_initializing():
+                # Continuous-batching decode step (serving.engine): every
+                # cache row is an INDEPENDENT in-flight request at its own
+                # fill index ``slot_cur[r]`` — the token writes there, and
+                # attention masks per row to [pad_lens[r], slot_cur[r]].
+                # The shared ``idx`` variable is NOT consulted or advanced
+                # (the engine owns per-slot fill state host-side), so slot
+                # refills never disturb the other rows' decode.
+                pads = (jnp.zeros((B,), jnp.int32) if pad_lens is None
+                        else pad_lens)
+                pos = jnp.maximum(slot_cur - pads, 0)[:, None]  # [B, 1]
+                q = rope(q, pos, c.rope_theta)
+                k = rope(k, pos, c.rope_theta)
+                def row_write(cache_b, upd_b, i):
+                    return jax.lax.dynamic_update_slice(
+                        cache_b, upd_b, (0, i, 0))
+
+                k_all = jax.vmap(row_write)(k_cache.value, k, slot_cur)
+                v_all = jax.vmap(row_write)(v_cache.value, v, slot_cur)
+                k_cache.value, v_cache.value = k_all, v_all
+                o = None
+                from ..ops import flash_decode as fd
+                dec = fd.decode_fn_for(resolved_attn)
+                if dec is not None and fd.supports(k_all.shape[2]):
+                    # per-row cur: each slot's HBM traffic scales with its
+                    # own fill level (the kernel's dead-block clamp is
+                    # per row).
+                    o = dec(q, k_all, v_all, slot_cur + 1, pads)
+                if o is None:
+                    max_len = k_all.shape[2]
+                    qg = q.reshape(B, c.num_kv_heads, rep, S, hd)
+                    s = jnp.einsum("bgrqd,bgkd->bgrqk", qg,
+                                   k_all) / math.sqrt(hd)
+                    col = jnp.arange(max_len)[None, :]
+                    valid = ((col <= slot_cur[:, None])
+                             & (col >= pads[:, None]))  # [B, max_len]
+                    s = jnp.where(valid[:, None, None, None],
+                                  s.astype(jnp.float32), -1e30)
+                    p = jax.nn.softmax(s, axis=-1).astype(d)
+                    o = jnp.einsum("bgrqk,bgkd->bgrqd", p, v_all).reshape(
+                        B, c.num_heads, S, hd)
+                # falls through to the shared o_proj tail below — the
+                # serving path must ride the exact same output
+                # projection as static generate() (token identity).
+            elif not self.is_initializing():
                 cur = idx.value
                 if pad_lens is None:
                     pos = cur + jnp.arange(S)  # [S], shared across rows
@@ -331,11 +375,11 @@ class LlamaLayer(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, decode: bool = False, pad_lens=None,
-                 first_chunk: bool = False):
+                 first_chunk: bool = False, slot_cur=None):
         c = self.cfg
         x = x + LlamaAttention(c, self.dtype, self.attn_fn, name="attn")(
             RMSNorm(c.rms_norm_eps, name="attn_norm")(x), positions, decode,
-            pad_lens, first_chunk)
+            pad_lens, first_chunk, slot_cur)
         x = x + LlamaMLP(c, self.dtype, name="mlp")(
             RMSNorm(c.rms_norm_eps, name="mlp_norm")(x))
         return x
@@ -349,14 +393,21 @@ class LlamaModel(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, decode: bool = False, pad_lens=None,
-                 first_chunk: bool = False):
+                 first_chunk: bool = False, slot_cur=None):
         """``first_chunk`` (decode mode, static): True ONLY when this
         apply() writes at cache index 0 — generate()'s single-call prefill
         passes it explicitly (``_prefill``). It enables the square flash
         fast path, which attends over the current chunk alone; at any
         other cache index that would silently ignore earlier cache, so
         the default is False and unaware multi-call chunked-prefill
-        callers get the (correct) dense attention over the full cache."""
+        callers get the (correct) dense attention over the full cache.
+
+        ``slot_cur`` (decode mode, ``[B]`` int32, traced): the
+        continuous-batching step — row r writes its single token at its
+        OWN cache fill index ``slot_cur[r]`` and attends to
+        ``[pad_lens[r], slot_cur[r]]`` of its row. Requires S == 1; the
+        shared ``idx`` cache variable is neither read nor advanced (the
+        serving engine owns per-slot fill state)."""
         c = self.cfg
         if pad_lens is not None and not decode:
             raise ValueError(
@@ -364,13 +415,19 @@ class LlamaModel(nn.Module):
                 "training path has no left-pad masking — feed right-padded "
                 "batches with a loss mask instead")
         S = input_ids.shape[1]
+        if slot_cur is not None and (not decode or S != 1):
+            raise ValueError(
+                "slot_cur is the per-slot decode STEP feature (decode=True, "
+                f"S == 1); got decode={decode}, S={S} — prefill a slot via "
+                "prefill_into_slot instead")
         positions = jnp.arange(S)
         x = nn.Embed(c.vocab_size, c.hidden_size, dtype=self.dtype,
                      name="embed_tokens")(input_ids)
         for i in range(c.num_layers):
             x = LlamaLayer(c, self.dtype, self.attn_fn,
                            name=f"layer_{i}")(x, positions, decode,
-                                              pad_lens, first_chunk)
+                                              pad_lens, first_chunk,
+                                              slot_cur)
         x = RMSNorm(c.rms_norm_eps, name="final_norm")(x)
         return nn.Dense(c.vocab_size, use_bias=False, dtype=jnp.float32,
                         name="lm_head")(x)
@@ -615,6 +672,95 @@ def generate(model: LlamaModel, variables, prompt_ids, max_new_tokens: int,
                             eos_id=None if eos_id is None else int(eos_id))
     ids = jnp.concatenate([prompt_ids, toks], axis=1)
     return (ids, int(n_steps)) if return_steps else ids
+
+
+# ---------------------------------------------------------------------------
+# Slot-level serving primitives (continuous batching — serving.engine)
+# ---------------------------------------------------------------------------
+# The static generate() path above runs whole batches in lockstep: every
+# row prefills together and the decode loop drains together. The two
+# functions below are the per-SLOT halves the in-flight batching engine
+# composes instead: ``prefill_into_slot`` writes one new request's cache
+# into one row of a shared slot cache (the other rows' in-flight state
+# untouched), and ``slot_decode_step`` advances EVERY slot one token at
+# its own fill index. Both are jitted with donated caches; the decode
+# step compiles once per (num_slots, max_len) and never re-traces across
+# refills — slot/cur/pad all ride as traced operands.
+
+
+@functools.partial(
+    jax.jit, static_argnames=("model", "temperature", "top_k", "top_p"),
+    donate_argnames=("cache",))
+def prefill_into_slot(model, params, prompt_ids, pad_len, cache, slot, rng,
+                      *, temperature: float = 0.0, top_k: int = 0,
+                      top_p: float = 1.0):
+    """Prefill ONE request into row ``slot`` of the engine's slot cache.
+
+    ``prompt_ids``: ``[1, Lb]`` int32, left-padded to the engine's bucket
+    length (``pad_len``: ``[1]`` int32 — same contract as
+    :func:`left_pad_prompts`); ``cache``: the ``[num_slots, ...]`` slot
+    cache (donated); ``slot``: traced int32 row index. The prompt runs
+    through the standard first-chunk prefill against a private
+    ``[1, Lb]``-length scratch cache (so compute is O(Lb²), never
+    O(Lb·max_len)), and the written K/V rows are scattered into the slot
+    row — positions count from the first real token, exactly the
+    ``generate()`` left-pad contract, so a refilled slot's logits are
+    bit-identical to a fresh static run of the same prompt.
+
+    Compiled once per bucket length ``Lb``; ``slot``/``pad_len`` are
+    traced, so refills into different slots share one program. Returns
+    ``(first_token [1] int32, cache)``.
+    """
+    lb = prompt_ids.shape[1]
+    small_shapes = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, lb), jnp.int32), decode=True))
+    small = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), small_shapes["cache"])
+    logits, mut = model.apply({"params": params, "cache": small},
+                              prompt_ids, decode=True, pad_lens=pad_len,
+                              first_chunk=True, mutable=["cache"])
+
+    def scatter(big, sm):
+        # K/V leaves are [slots, Hkv, L, hd] vs [1, Hkv, Lb, hd]; the
+        # scalar ``idx`` leaf is the static path's shared fill index —
+        # the engine tracks per-slot fill host-side, so it stays as-is.
+        if getattr(sm, "ndim", 0) == 4:
+            return jax.lax.dynamic_update_slice(
+                big, sm.astype(big.dtype), (slot, 0, 0, 0))
+        return big
+
+    cache = jax.tree_util.tree_map(scatter, cache, mut["cache"])
+    tok = _sample(logits[:, -1].astype(jnp.float32), rng, temperature,
+                  top_k, top_p)
+    return tok, cache
+
+
+@functools.partial(
+    jax.jit, static_argnames=("model", "temperature", "top_k", "top_p"),
+    donate_argnames=("cache",))
+def slot_decode_step(model, params, cache, tokens, slot_cur, pad_lens, rng,
+                     *, temperature: float = 0.0, top_k: int = 0,
+                     top_p: float = 1.0):
+    """One in-flight batching decode iteration: every slot advances one
+    token at its OWN fill index.
+
+    ``tokens``: ``[num_slots]`` int32 (each slot's current token — for
+    idle slots the value is irrelevant, their output is discarded
+    host-side); ``slot_cur``: ``[num_slots]`` int32 per-slot fill
+    indices (the token writes there; attention masks to
+    ``[pad_lens[r], slot_cur[r]]``); ``cache`` donated. Compiled ONCE
+    per (num_slots, max_len) signature — the engine's steady-state hot
+    program; slot refills and retirements never re-trace it. Returns
+    ``(next_tokens [num_slots] int32, cache)``.
+    """
+    logits, mut = model.apply({"params": params, "cache": cache},
+                              tokens[:, None], decode=True,
+                              pad_lens=pad_lens, slot_cur=slot_cur,
+                              mutable=["cache"])
+    nxt = _sample(logits[:, -1].astype(jnp.float32), rng, temperature,
+                  top_k, top_p)
+    return nxt, mut["cache"]
 
 
 # ---------------------------------------------------------------------------

@@ -67,7 +67,9 @@ def _decode_kernel(cur_ref, pad_ref, q_ref, k_ref, v_ref, o_ref,
     running max/normalizer, persistent across KV steps."""
     bh, j = pl.program_id(0), pl.program_id(1)
     n_kv = pl.num_programs(1)
-    cur = cur_ref[0]
+    cur = cur_ref[bh // h_kv]  # per-row fill index (broadcast scalar or
+    # per-slot vector — the continuous-batching engine's slots each sit
+    # at their own fill level)
 
     @pl.when(j == 0)
     def _init():
@@ -119,13 +121,16 @@ def flash_decode(q, k_cache, v_cache, cur, pad_lens=None, *,
     """Single-step cache attention. ``q``: ``[B, Hq, 1, D]`` (the decode
     token's queries), ``k_cache``/``v_cache``: ``[B, Hkv, L, D]`` with
     ``Hq % Hkv == 0`` (GQA), ``cur``: scalar int32 — slots ``>= cur`` are
-    unwritten and excluded, ``pad_lens``: optional ``[B]`` int32 — row
-    r's slots ``< pad_lens[r]`` are left-padding, excluded. Returns
-    ``[B, Hq, 1, D]``.
+    unwritten and excluded — or ``[B]`` int32 per-row fill indices (the
+    continuous-batching slot cache, where every row is a different
+    request at its own fill level), ``pad_lens``: optional ``[B]`` int32
+    — row r's slots ``< pad_lens[r]`` are left-padding, excluded.
+    Returns ``[B, Hq, 1, D]``.
 
     HBM traffic per step is ``O(cur)``, not ``O(L)``: blocks at or past
-    ``cur`` are clamped to the last live block in the index map (DMA
-    skipped for the repeat) and their compute is ``pl.when``-gated off.
+    ``cur`` (per row, when ``cur`` is a vector) are clamped to the last
+    live block in the index map (DMA skipped for the repeat) and their
+    compute is ``pl.when``-gated off.
     """
     from jax.experimental.pallas import tpu as pltpu
 
@@ -152,14 +157,20 @@ def flash_decode(q, k_cache, v_cache, cur, pad_lens=None, *,
     q3 = q3.reshape(b * h_kv, g, d)
     k3 = k_cache.reshape(b * h_kv, max_len, d)
     v3 = v_cache.reshape(b * h_kv, max_len, d)
-    cur_arr = jnp.full((1,), cur, jnp.int32)
+    cur = jnp.asarray(cur, jnp.int32)
+    if cur.ndim not in (0, 1) or (cur.ndim == 1 and cur.shape[0] != b):
+        raise ValueError(f"cur must be a scalar or [B={b}] vector, got "
+                         f"shape {cur.shape}")
+    cur_arr = jnp.broadcast_to(jnp.atleast_1d(cur), (b,))
     pad_arr = (jnp.zeros((b,), jnp.int32) if pad_lens is None
                else pad_lens.astype(jnp.int32))
 
     def kv_index(bh, j, cur_ref, pad_ref):
-        # Dead blocks re-reference the last live block: consecutive equal
-        # indices skip the HBM fetch, so the dead tail costs no bandwidth.
-        last_live = jnp.maximum(pl.cdiv(cur_ref[0], bk) - 1, 0)
+        # Dead blocks re-reference the last live block (per row, so each
+        # slot's bandwidth scales with its own fill level): consecutive
+        # equal indices skip the HBM fetch — the dead tail costs no
+        # bandwidth.
+        last_live = jnp.maximum(pl.cdiv(cur_ref[bh // h_kv], bk) - 1, 0)
         return (bh, jnp.minimum(j, last_live), 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(

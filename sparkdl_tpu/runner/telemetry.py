@@ -57,7 +57,7 @@ __all__ = [
     "start", "stop", "enabled", "maybe_start_from_env", "registry",
     "accountant", "snapshot", "flush_snapshot", "render_prometheus",
     "aggregate_snapshots", "clear_rank_files", "stage_utilization_summary",
-    "server_port",
+    "server_port", "histogram_quantile",
 ]
 
 log = logging.getLogger("sparkdl_tpu.runner")
@@ -168,6 +168,43 @@ class Histogram:
     def snapshot(self):
         return {"bounds": list(self.bounds), "buckets": list(self.buckets),
                 "count": self.count, "sum": round(self.sum, 6)}
+
+    def quantile(self, q: float) -> float | None:
+        """Bucket-resolution quantile of the live histogram — see
+        :func:`histogram_quantile` (one shared derivation for the
+        serving bench, ``bottleneck_report`` and ad-hoc callers)."""
+        return histogram_quantile(self.snapshot(), q)
+
+
+def histogram_quantile(hist: dict, q: float) -> float | None:
+    """Quantile estimate from a cumulative-bucket histogram snapshot
+    (``Histogram.snapshot()`` / the gang-aggregated shape:
+    ``{bounds, buckets, count, sum}``).
+
+    Prometheus ``histogram_quantile`` semantics: find the first bucket
+    whose cumulative count covers rank ``q·count`` and interpolate
+    linearly inside it (lower edge 0 for the first bucket).
+    Observations past the last finite bound (the implicit ``+Inf``
+    bucket) resolve to the last finite bound — a bucket with no upper
+    edge has no interpolable width. Returns None for an empty
+    histogram. This is THE latency-percentile derivation: the serving
+    bench and ``scripts/bottleneck_report.py`` both call it, so their
+    p50/p95/p99 can never disagree on the same snapshot."""
+    count = int(hist.get("count") or 0)
+    bounds = list(hist.get("bounds") or [])
+    buckets = list(hist.get("buckets") or [])
+    if count <= 0 or not bounds or len(bounds) != len(buckets):
+        return None
+    q = min(1.0, max(0.0, float(q)))
+    rank = q * count
+    prev_cum, prev_bound = 0, 0.0
+    for bound, cum in zip(bounds, buckets):
+        if cum >= rank and cum > prev_cum:
+            width = bound - prev_bound
+            frac = (rank - prev_cum) / (cum - prev_cum)
+            return round(prev_bound + width * max(0.0, frac), 9)
+        prev_cum, prev_bound = cum, bound
+    return float(bounds[-1])  # rank lands in +Inf: report the last edge
 
 
 class MetricsRegistry:
@@ -782,6 +819,7 @@ def aggregate_snapshots(metrics_dir: str) -> dict | None:
     events_total: dict[str, int] = {}
     counters: dict[str, float] = {}
     gauges: dict[str, dict] = {}
+    histograms: dict[str, dict] = {}
     for snap in ranks.values():
         for name, st in (snap.get("stages") or {}).items():
             agg = stages.setdefault(name, {
@@ -802,6 +840,20 @@ def aggregate_snapshots(metrics_dir: str) -> dict | None:
             cur = gauges.setdefault(name, {"value": 0.0, "max": 0.0})
             cur["value"] = max(cur["value"], float(g.get("value") or 0.0))
             cur["max"] = max(cur["max"], float(g.get("max") or 0.0))
+        for name, h in (snap.get("histograms") or {}).items():
+            bounds = list(h.get("bounds") or [])
+            agg = histograms.setdefault(name, {
+                "bounds": bounds, "buckets": [0] * len(bounds),
+                "count": 0, "sum": 0.0})
+            if agg["bounds"] != bounds:
+                # Bucket layouts must agree to merge cumulative counts
+                # (all ranks share the registry defaults; a custom
+                # mismatch is skipped rather than summed into nonsense).
+                continue
+            agg["buckets"] = [a + int(b) for a, b in
+                              zip(agg["buckets"], h.get("buckets") or [])]
+            agg["count"] += int(h.get("count") or 0)
+            agg["sum"] = round(agg["sum"] + float(h.get("sum") or 0.0), 6)
     n_ranks = len(ranks)
     for name, st in stages.items():
         # Gang busy fraction: wall-busy summed over ranks against the
@@ -821,6 +873,8 @@ def aggregate_snapshots(metrics_dir: str) -> dict | None:
         out["counters"] = counters
     if gauges:
         out["gauges"] = gauges
+    if histograms:
+        out["histograms"] = histograms
     return out
 
 

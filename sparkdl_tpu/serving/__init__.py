@@ -1,0 +1,18 @@
+"""Online serving tier — continuous-batching generation engine (ISSUE 8).
+
+``engine`` is jax-free (the scheduler, queue, slot table, and request
+state machine import nothing heavier than the flight recorder and the
+telemetry plane); the jax half lives in ``backend`` and is imported
+lazily by :meth:`GenerationEngine.from_model`.
+"""
+
+from .engine import (EngineStopped, GenerationEngine, QueueFullError,
+                     Request, RequestQuarantined, RequestRejected,
+                     ServingError, ServingStallError, StubBackend,
+                     bucket_length)
+
+__all__ = [
+    "GenerationEngine", "Request", "StubBackend", "bucket_length",
+    "ServingError", "RequestRejected", "QueueFullError",
+    "RequestQuarantined", "ServingStallError", "EngineStopped",
+]

@@ -1,0 +1,186 @@
+"""LlamaSlotBackend — the jax half of the continuous-batching engine.
+
+Owns the device-resident slot cache and the per-slot fill state
+(``cur``/``pad_lens`` vectors), and drives the two jitted slot
+primitives in ``models.llama``:
+
+- ``prefill_into_slot``: one compiled program per prompt-length
+  *bucket* (``serving.engine.bucket_length``), slot index traced — a
+  refill never re-traces, whatever slot it lands in;
+- ``slot_decode_step``: ONE compiled program per (num_slots, max_len)
+  for the engine's whole lifetime — the steady-state hot path.
+
+Both signatures are routed through ``GLOBAL_COMPILE_CACHE.note`` so
+every (re)compilation is a visible flight-recorder ``recompile`` event:
+the serving bench pins "no decode-step re-trace after warmup" on
+exactly that evidence.
+
+Sampling: greedy (``temperature<=0``) is deterministic and
+token-identical to the static ``generate()`` path for the same prompt
+(the equivalence tests and example Part 3 pin this). With temperature
+sampling the rng is folded per decode step / per prefill — streams are
+reproducible for a fixed engine schedule, but are NOT the same draws
+``generate()`` makes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.runtime import GLOBAL_COMPILE_CACHE
+from ..models import llama as L
+
+
+class SlotCacheLost(RuntimeError):
+    """A jitted slot call failed after consuming the donated cache: the
+    in-flight KV state is unrecoverable, so retrying the call cannot
+    help (every retry would read a deleted buffer). ``serving_fatal``
+    tells the (jax-free) engine to fail over cleanly instead of burning
+    its retry budget and evicting innocent requests one by one."""
+
+    serving_fatal = True
+
+
+def _tree_sig(tree):
+    """(shape, dtype) of every leaf — the part of the call signature
+    jax actually traces. Keying the compile-cache note on THIS (not on
+    config constants) makes the no-re-trace pin real: an operand dtype
+    or shape drift becomes a visible new signature."""
+    return tuple((tuple(getattr(x, "shape", ())), str(getattr(x, "dtype",
+                                                              "")))
+                 for x in jax.tree_util.tree_leaves(tree))
+
+
+class LlamaSlotBackend:
+    """Slot backend over ``models.llama`` (see module doc).
+
+    ``num_slots`` cache rows, each independently one in-flight request;
+    ``max_len`` cache slots per row (a request needs
+    ``bucket(prompt) + max_new_tokens <= max_len`` — the engine's
+    admission check). The cache rides the jitted calls with buffer
+    donation, so the HBM footprint stays one cache regardless of how
+    many refills happen.
+    """
+
+    def __init__(self, model, variables, num_slots: int, max_len: int, *,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, seed: int = 0):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if max_len < 2:
+            raise ValueError(f"max_len must be >= 2, got {max_len}")
+        self.model = model
+        self.params = variables["params"] if "params" in variables \
+            else variables
+        self.num_slots = int(num_slots)
+        self.max_len = int(max_len)
+        self.vocab_size = int(model.cfg.vocab_size)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.cache = L.init_cache(model, self.num_slots, self.max_len)
+        self._tokens = np.zeros(self.num_slots, np.int32)
+        # Idle slots park at fill index 0: the step's (masked, discarded)
+        # write lands inside the row and the engine never reads it.
+        self._cur = np.zeros(self.num_slots, np.int32)
+        self._pads = np.zeros(self.num_slots, np.int32)
+        self._rng = jax.random.PRNGKey(seed)
+        self._step_i = 0
+        self._prefill_i = 0
+
+    # -- engine protocol --------------------------------------------------
+    def prefill(self, slot: int, prompt, bucket: int) -> int:
+        """Prefill ``prompt`` (left-padded to ``bucket``) into ``slot``;
+        returns the first sampled token."""
+        if bucket > self.max_len:
+            raise ValueError(f"bucket {bucket} > max_len {self.max_len}")
+        ids, pad = L.left_pad_prompts([list(prompt)], pad_to=bucket)
+        ids_arr, pad_arr = jnp.asarray(ids), jnp.asarray(pad)
+        # One compiled prefill per bucket length (slot index is traced):
+        # a NEW bucket is a visible recompile event, a seen one is not.
+        # Keyed on the TRACED signature (operand + cache shapes/dtypes),
+        # so a genuine re-trace regression shows up as new signatures.
+        GLOBAL_COMPILE_CACHE.note(
+            "serve_prefill",
+            (_tree_sig((ids_arr, pad_arr)), _tree_sig(self.cache),
+             self.temperature, self.top_k, self.top_p))
+        key = self._rng if self.temperature <= 0.0 else \
+            jax.random.fold_in(self._rng, (1 << 20) + self._prefill_i)
+        self._prefill_i += 1
+        tok, self.cache = self._guarded(
+            L.prefill_into_slot, self.model, self.params, ids_arr,
+            pad_arr, self.cache, jnp.int32(slot), key,
+            temperature=self.temperature, top_k=self.top_k,
+            top_p=self.top_p)
+        tok = int(np.asarray(tok)[0])
+        self._tokens[slot] = tok
+        self._cur[slot] = bucket
+        self._pads[slot] = int(pad[0])
+        return tok
+
+    def step(self, active_slots) -> list[int]:
+        """Advance every slot one token at its own fill index; returns
+        the per-slot token list (idle slots' entries are garbage — the
+        engine only reads ``active_slots``)."""
+        tok_arr = jnp.asarray(self._tokens)
+        cur_arr = jnp.asarray(self._cur)
+        pads_arr = jnp.asarray(self._pads)
+        # Keyed on the traced signature (see prefill): after warmup this
+        # must stay ONE signature for the engine's lifetime — the
+        # acceptance observable for "refills never re-trace the step".
+        GLOBAL_COMPILE_CACHE.note(
+            "serve_decode_step",
+            (_tree_sig((tok_arr, cur_arr, pads_arr)),
+             _tree_sig(self.cache), self.temperature, self.top_k,
+             self.top_p))
+        # Greedy sampling never reads the key — skip the per-step fold_in
+        # dispatch (one fewer device op on the hot loop).
+        key = self._rng if self.temperature <= 0.0 else \
+            jax.random.fold_in(self._rng, self._step_i)
+        self._step_i += 1
+        nxt, self.cache = self._guarded(
+            L.slot_decode_step, self.model, self.params, self.cache,
+            tok_arr, cur_arr, pads_arr, key,
+            temperature=self.temperature, top_k=self.top_k,
+            top_p=self.top_p)
+        nxt = np.asarray(nxt).astype(np.int32)
+        # Only busy slots advance their fill index (each just wrote at
+        # cur, the next token lands at cur+1 — admission guarantees
+        # bucket + max_new <= max_len so this never overruns); idle
+        # slots stay parked and their write is masked garbage.
+        active = np.asarray(sorted(active_slots), np.int32)
+        self._cur[active] += 1
+        self._tokens[active] = nxt[active]
+        return nxt.tolist()
+
+    def _guarded(self, fn, *args, **kw):
+        """Run one jitted slot call; if it raises AFTER consuming the
+        donated cache (a mid-execution device error — the cache buffer
+        is deleted by donation), convert to :class:`SlotCacheLost` so
+        the engine fails over instead of retrying against a deleted
+        array and evicting innocent requests one by one. Host-side
+        failures (validation, chaos before dispatch) leave the cache
+        alive and keep the per-request retry/quarantine path."""
+        try:
+            return fn(*args, **kw)
+        except SlotCacheLost:
+            raise
+        except Exception as e:
+            lost = any(getattr(x, "is_deleted", lambda: False)()
+                       for x in jax.tree_util.tree_leaves(self.cache))
+            if lost:
+                raise SlotCacheLost(
+                    f"slot cache consumed by failed "
+                    f"{getattr(fn, '__name__', fn)}: "
+                    f"{type(e).__name__}: {e}") from e
+            raise
+
+    def release(self, slot: int):
+        """Retire hook: park the slot at fill index 0 (its stale cache
+        rows are dead — a future refill overwrites [0, bucket) and masks
+        everything past its own fill index)."""
+        self._cur[slot] = 0
+        self._pads[slot] = 0
+        self._tokens[slot] = 0

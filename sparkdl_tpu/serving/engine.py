@@ -1,0 +1,746 @@
+"""Continuous-batching generation engine — in-flight batching over the
+slotted KV cache (ISSUE 8 tentpole).
+
+The static ``models.llama.generate`` path is batch-job shaped: every row
+of a batch prefills together, decodes in lockstep, and a new request
+waits for the whole batch to drain. This module is the request-level
+tier on top of the same two compiled programs' *slot* variants
+(``models.llama.prefill_into_slot`` / ``slot_decode_step``): a request
+queue with admission control feeds a fixed table of ``num_slots`` cache
+slots, each independently holding one in-flight request. Every engine
+iteration:
+
+1. finished slots (EOS / max-tokens) are **retired** and their requests
+   completed;
+2. free slots are **refilled** from the queue — the new prompt prefills
+   *into that slot* (one bucketed prefill program per prompt-length
+   bucket) while the other slots' in-flight state stays put;
+3. one **decode step** advances every busy slot one token at its own
+   fill index — compiled once per (num_slots, max_len), never re-traced
+   by refills, so the batch never drains and aggregate tokens/s is
+   bounded by compute, not by the longest request in a batch.
+
+Design split: this module is **jax-free** — the scheduler, queue, slot
+table, request state machine, streaming callbacks, and failure policy
+are all plain Python against a duck-typed backend (``prefill(slot,
+prompt, bucket) -> first_token``, ``step(active_slots) -> tokens [num_
+slots]``), so the whole scheduling layer unit-tests without a device.
+The jax half is ``serving.backend.LlamaSlotBackend`` (lazily imported
+by :meth:`GenerationEngine.from_model`); :class:`StubBackend` here is
+the deterministic jax-free stand-in the scheduler tests and the
+backend-outage bench leg ride.
+
+Failure semantics (the PR 4 posture, request-granular): a prompt that
+fails admission is **rejected** synchronously (``RequestRejected`` /
+``QueueFullError`` — backpressure, the caller owns retry); a request
+whose prefill raises is retried ``SPARKDL_SERVE_RETRIES`` times and
+then **quarantined** (request failed, engine keeps serving — the
+poisoned request is evicted, not the gang); a decode-step failure is
+retried, then the newest-admitted request (the state-change suspect) is
+evicted and quarantined and the step retried again — down to an empty
+slot table if need be, the engine staying alive for the queue (a
+genuinely broken backend degrades per-request, each refill burning its
+own retry budget, never gang-fatally). ``SPARKDL_SERVE_STALL_S`` arms a
+wall-clock watchdog on every backend call — a wedged device surfaces as
+a classified ``ServingStallError`` instead of an eternal hang.
+
+Observability: per-request ``serve_queue`` / ``serve_prefill`` /
+``serve_decode`` spans through the flight recorder, and (when the
+telemetry plane is armed) ``serving_queue_depth`` / ``serving_slots_
+busy`` gauges, token/request counters, and request-latency + TTFT
+histograms — the serving bench derives its latency percentiles from
+those histograms via :func:`runner.telemetry.histogram_quantile`.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import logging
+import os
+import threading
+import time
+
+from ..runner import events, telemetry
+
+__all__ = [
+    "GenerationEngine", "Request", "StubBackend", "bucket_length",
+    "ServingError", "RequestRejected", "QueueFullError",
+    "RequestQuarantined", "ServingStallError", "EngineStopped",
+]
+
+log = logging.getLogger("sparkdl_tpu.serving")
+
+SLOTS_ENV = "SPARKDL_SERVE_SLOTS"
+MAX_LEN_ENV = "SPARKDL_SERVE_MAX_LEN"
+QUEUE_CAP_ENV = "SPARKDL_SERVE_QUEUE_CAP"
+RETRIES_ENV = "SPARKDL_SERVE_RETRIES"
+STALL_ENV = "SPARKDL_SERVE_STALL_S"
+MIN_BUCKET_ENV = "SPARKDL_SERVE_MIN_BUCKET"
+
+_DEFAULT_SLOTS = 8
+_DEFAULT_MAX_LEN = 2048
+_DEFAULT_QUEUE_CAP = 128
+_DEFAULT_RETRIES = 1
+_DEFAULT_MIN_BUCKET = 16
+
+# Request-latency-shaped histogram bounds (seconds). The telemetry
+# default buckets top out at 10s (span-duration-shaped) — a long-tail
+# generation easily waits + decodes past that, and the quantile helper
+# clamps +Inf-bucket ranks to the last finite bound, which would
+# silently saturate the bench's p95/p99 at 10.0.
+_LATENCY_BUCKETS = (0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                    10.0, 30.0, 60.0, 180.0, 600.0)
+
+
+def _env_num(name: str, default, cast=int):
+    try:
+        return cast(os.environ[name])
+    except (KeyError, ValueError):
+        return default
+
+
+class ServingError(RuntimeError):
+    """Base class for serving-tier failures."""
+
+
+class RequestRejected(ServingError):
+    """Admission control refused the request (invalid prompt, or the
+    bucketed prompt + max_new_tokens cannot fit the slot cache)."""
+
+
+class QueueFullError(ServingError):
+    """Backpressure: the request queue is at capacity and the caller
+    asked not to (or timed out waiting to) block."""
+
+
+class RequestQuarantined(ServingError):
+    """The request failed ``retries + 1`` attempts and was evicted; the
+    engine keeps serving the other requests."""
+
+
+class ServingStallError(ServingError):
+    """A backend call exceeded ``SPARKDL_SERVE_STALL_S`` wall seconds."""
+
+
+class EngineStopped(ServingError):
+    """The engine stopped (or died) before this request completed."""
+
+
+def bucket_length(prompt_len: int, min_bucket: int = _DEFAULT_MIN_BUCKET
+                  ) -> int:
+    """Prefill bucket for a prompt: the next power of two >=
+    max(prompt_len, min_bucket). Every distinct bucket is one compiled
+    prefill program, so the program count is bounded by
+    log2(max_len / min_bucket) + 1 — a mixed-length request stream
+    compiles a handful of prefills and then never re-traces."""
+    if prompt_len < 1:
+        raise ValueError("prompt must hold at least one token")
+    b = max(1, min_bucket)
+    while b < prompt_len:
+        b <<= 1
+    return b
+
+
+# Request lifecycle states (plain strings — they serialize into events
+# and stats as-is).
+QUEUED = "queued"
+RUNNING = "running"
+DONE = "done"
+FAILED = "failed"
+
+
+class Request:
+    """One in-flight generation request: the handle ``submit`` returns.
+
+    ``tokens`` grows as the engine emits (``stream_cb(request, token)``
+    fires per token, in emission order, from the engine thread);
+    ``result()`` blocks until retirement and returns the generated
+    tokens (prompt excluded; the EOS token, when hit, is included —
+    exactly ``generate()``'s contract).
+    """
+
+    def __init__(self, rid: int, prompt, max_new_tokens: int, bucket: int,
+                 stream_cb=None):
+        self.id = rid
+        self.prompt = list(prompt)
+        self.max_new_tokens = max_new_tokens
+        self.bucket = bucket
+        self.stream_cb = stream_cb
+        self.tokens: list[int] = []
+        self.state = QUEUED
+        self.finish_reason: str | None = None   # eos | length | error
+        self.error: BaseException | None = None
+        self.failures = 0
+        self.slot: int | None = None
+        self.t_submit = time.time()
+        self.t_admit: float | None = None
+        self.t_first_token: float | None = None
+        self.t_done: float | None = None
+        self._done = threading.Event()
+
+    # -- caller-side API --------------------------------------------------
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self._done.wait(timeout)
+
+    def result(self, timeout: float | None = None) -> list[int]:
+        """Generated token ids (prompt excluded). Raises the request's
+        failure (``RequestQuarantined`` / ``EngineStopped`` / the
+        backend error) when it did not complete."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"request {self.id} not done after "
+                               f"{timeout}s")
+        if self.state != DONE:
+            raise self.error if self.error is not None else \
+                ServingError(f"request {self.id} ended in state "
+                             f"{self.state}")
+        return list(self.tokens)
+
+    def __repr__(self):
+        return (f"Request(id={self.id}, state={self.state}, "
+                f"n_prompt={len(self.prompt)}, n_out={len(self.tokens)})")
+
+
+class StubBackend:
+    """Deterministic jax-free backend: scheduler tests and the
+    backend-outage bench leg measure queue/slot mechanics (and raw
+    scheduler throughput) without a device.
+
+    Token stream per request: ``key = sum(prompt) + len(prompt)``,
+    ``tok_n = (seed + key·31 + n·7) % vocab_size`` — deterministic in
+    the prompt alone, so two runs of the same workload emit identical
+    streams regardless of slot placement. ``step_s``/``prefill_s`` add
+    synthetic per-call latency (bench shaping)."""
+
+    def __init__(self, num_slots: int, max_len: int, *,
+                 vocab_size: int = 32000, step_s: float = 0.0,
+                 prefill_s: float = 0.0, seed: int = 0):
+        self.num_slots = num_slots
+        self.max_len = max_len
+        self.vocab_size = vocab_size
+        self.step_s = step_s
+        self.prefill_s = prefill_s
+        self.seed = seed
+        self._state = [(0, 0)] * num_slots  # (prompt_key, n_emitted)
+
+    def _tok(self, key: int, n: int) -> int:
+        return (self.seed + key * 31 + n * 7) % self.vocab_size
+
+    def prefill(self, slot: int, prompt, bucket: int) -> int:
+        if self.prefill_s:
+            time.sleep(self.prefill_s)
+        key = sum(prompt) + len(prompt)
+        self._state[slot] = (key, 1)
+        return self._tok(key, 0)
+
+    def step(self, active_slots) -> list[int]:
+        if self.step_s:
+            time.sleep(self.step_s)
+        out = [0] * self.num_slots
+        for s in active_slots:
+            key, n = self._state[s]
+            out[s] = self._tok(key, n)
+            self._state[s] = (key, n + 1)
+        return out
+
+
+class GenerationEngine:
+    """Iteration-level scheduler over a slot backend (see module doc).
+
+    Drive it inline (``step()`` / ``run_until_idle()`` — tests, batch
+    drains) or as a background thread (``start()`` / ``stop()``, or the
+    context manager). ``submit()`` is thread-safe and applies admission
+    control synchronously.
+    """
+
+    def __init__(self, backend, *, eos_id: int | None = None,
+                 queue_capacity: int | None = None,
+                 retries: int | None = None,
+                 stall_s: float | None = None,
+                 min_bucket: int | None = None):
+        self.backend = backend
+        self.eos_id = eos_id
+        # Floor 1: capacity 0 would make every blocking submit() spin
+        # forever on `len(queue) >= 0` with no exit condition.
+        self.queue_capacity = max(1, queue_capacity
+                                  if queue_capacity is not None
+                                  else _env_num(QUEUE_CAP_ENV,
+                                                _DEFAULT_QUEUE_CAP))
+        self.retries = max(0, retries if retries is not None
+                           else _env_num(RETRIES_ENV, _DEFAULT_RETRIES))
+        self.stall_s = stall_s if stall_s is not None \
+            else _env_num(STALL_ENV, 0.0, float)
+        self.min_bucket = min_bucket if min_bucket is not None \
+            else _env_num(MIN_BUCKET_ENV, _DEFAULT_MIN_BUCKET)
+        self._queue: collections.deque[Request] = collections.deque()
+        self._slots: list[Request | None] = [None] * backend.num_slots
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._ids = itertools.count()
+        self._thread: threading.Thread | None = None
+        self._stop_mode: str | None = None  # None | "drain" | "now"
+        self._fatal: BaseException | None = None
+        self._watch_pool = None  # lazy ThreadPoolExecutor(1) when stall_s
+        self.stats = {
+            "submitted": 0, "rejected": 0, "completed": 0,
+            "quarantined": 0, "failed": 0, "tokens_out": 0, "steps": 0,
+            "prefills": 0, "prefill_retries": 0, "step_retries": 0,
+            "peak_queue_depth": 0, "peak_slots_busy": 0,
+            "callback_errors": 0,
+        }
+
+    # -- construction -----------------------------------------------------
+    @classmethod
+    def from_model(cls, model, variables, *, num_slots: int | None = None,
+                   max_len: int | None = None, temperature: float = 0.0,
+                   top_k: int = 0, top_p: float = 1.0, seed: int = 0,
+                   eos_id: int | None = None, **kw) -> "GenerationEngine":
+        """Build an engine over :class:`serving.backend.LlamaSlotBackend`
+        (the jax import happens here, not at module import)."""
+        from .backend import LlamaSlotBackend  # deferred: jax
+        num_slots = num_slots if num_slots is not None \
+            else _env_num(SLOTS_ENV, _DEFAULT_SLOTS)
+        max_len = max_len if max_len is not None \
+            else _env_num(MAX_LEN_ENV, _DEFAULT_MAX_LEN)
+        backend = LlamaSlotBackend(model, variables, num_slots, max_len,
+                                   temperature=temperature, top_k=top_k,
+                                   top_p=top_p, seed=seed)
+        return cls(backend, eos_id=eos_id, **kw)
+
+    # -- telemetry helpers ------------------------------------------------
+    def _metric(self, kind: str, name: str, *args):
+        if not telemetry.enabled():
+            return
+        reg = telemetry.registry()
+        if kind == "counter":
+            reg.counter(name).inc(*args)
+        elif kind == "gauge":
+            reg.gauge(name).set(*args)
+        else:
+            reg.histogram(name, _LATENCY_BUCKETS).observe(*args)
+
+    # -- admission --------------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens: int = 16, *,
+               stream_cb=None, block: bool = True,
+               timeout: float | None = None) -> Request:
+        """Queue one request; returns its :class:`Request` handle.
+
+        Admission control is synchronous: an invalid prompt (empty, or
+        out-of-vocab ids when the backend knows its vocab) or one whose
+        ``bucket + max_new_tokens`` cannot fit the slot cache raises
+        :class:`RequestRejected`; a full queue blocks (``block=True``,
+        up to ``timeout``) or raises :class:`QueueFullError` — that is
+        the backpressure contract, the caller owns retry/shedding.
+        """
+        prompt = [int(t) for t in prompt_ids]
+        if not prompt:
+            self._reject("empty prompt (needs >= 1 token id)")
+        if max_new_tokens < 1:
+            self._reject("max_new_tokens < 1")
+        vocab = getattr(self.backend, "vocab_size", None)
+        if vocab is not None and any(t < 0 or t >= vocab for t in prompt):
+            # the poisoned-request fast path: a corrupt id would index
+            # the embedding out of range (silently clamped on-device) —
+            # reject at the door, with the offending id named
+            bad = next(t for t in prompt if t < 0 or t >= vocab)
+            self._reject(f"token id {bad} outside vocab [0, {vocab})")
+        bucket = bucket_length(len(prompt), self.min_bucket)
+        if bucket + max_new_tokens > self.backend.max_len:
+            self._reject(
+                f"bucketed prompt ({bucket}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_len "
+                f"{self.backend.max_len}")
+        deadline = None if timeout is None else time.time() + timeout
+        with self._work:
+            if self._stop_mode is not None or self._fatal is not None:
+                raise EngineStopped("engine is stopped")
+            while len(self._queue) >= self.queue_capacity:
+                if not block:
+                    self._reject_locked("queue_full", QueueFullError)
+                remain = None if deadline is None \
+                    else deadline - time.time()
+                if remain is not None and remain <= 0:
+                    self._reject_locked("queue_full_timeout",
+                                        QueueFullError)
+                if not self._work.wait(timeout=remain if remain is not None
+                                       else 0.5):
+                    if deadline is not None:
+                        self._reject_locked("queue_full_timeout",
+                                            QueueFullError)
+                if self._stop_mode is not None or self._fatal is not None:
+                    raise EngineStopped("engine is stopped")
+            req = Request(next(self._ids), prompt, int(max_new_tokens),
+                          bucket, stream_cb)
+            self._queue.append(req)
+            self.stats["submitted"] += 1
+            depth = len(self._queue)
+            if depth > self.stats["peak_queue_depth"]:
+                self.stats["peak_queue_depth"] = depth
+            self._work.notify_all()
+        self._metric("gauge", "serving_queue_depth", depth)
+        return req
+
+    def _reject(self, reason: str, exc_type=RequestRejected):
+        with self._lock:
+            self._reject_locked(reason, exc_type)
+
+    def _reject_locked(self, reason: str, exc_type=RequestRejected):
+        """Caller holds the lock; raises after recording the rejection."""
+        self.stats["rejected"] += 1
+        events.event("serve_reject", reason=reason[:200])
+        self._metric("counter", "serving_requests_rejected_total")
+        raise exc_type(reason)
+
+    # -- scheduling loop --------------------------------------------------
+    def step(self) -> bool:
+        """One scheduler iteration: retire/refill free slots from the
+        queue, then advance every busy slot one token. Returns True when
+        any work happened (refill or decode); False when idle — the
+        inline-drive loop condition."""
+        if self._fatal is not None:
+            raise EngineStopped("engine died") from self._fatal
+        refilled = self._refill()
+        with self._lock:
+            active = [(s, r) for s, r in enumerate(self._slots)
+                      if r is not None]
+        busy = len(active)
+        if busy > self.stats["peak_slots_busy"]:
+            self.stats["peak_slots_busy"] = busy
+        self._metric("gauge", "serving_slots_busy", busy)
+        if not active:
+            return refilled > 0
+        toks = self._step_with_isolation()
+        if toks is not None:
+            self.stats["steps"] += 1
+            for slot, req in active:
+                if req.state == RUNNING:  # not evicted mid-isolation
+                    self._deliver(req, int(toks[slot]))
+        return True
+
+    def run_until_idle(self):
+        """Drive inline until the queue is empty and every slot idle."""
+        while self.step():
+            pass
+
+    def start(self) -> "GenerationEngine":
+        """Run the scheduling loop in a daemon thread."""
+        with self._lock:
+            if self._thread is not None:
+                return self
+            self._stop_mode = None
+            self._thread = threading.Thread(
+                target=self._loop, name="sparkdl-serve-engine", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout: float | None = None):
+        """Stop the background loop. ``drain=True`` finishes queued and
+        in-flight requests first; ``drain=False`` fails them with
+        :class:`EngineStopped`."""
+        with self._work:
+            self._stop_mode = "drain" if drain else "now"
+            self._work.notify_all()
+            t = self._thread
+        if t is not None:
+            t.join(timeout)
+            if t.is_alive():
+                # The loop is wedged past the join timeout: leave
+                # _thread set so a later start() cannot spawn a SECOND
+                # loop over the same slot table.
+                log.warning("serve engine loop still running after "
+                            "stop(timeout=%s); not restartable until it "
+                            "exits", timeout)
+            else:
+                with self._lock:
+                    if self._thread is t:  # a concurrent start() may
+                        self._thread = None  # already own the handle
+        if not drain:
+            self._fail_pending(EngineStopped("engine stopped"))
+        pool, self._watch_pool = self._watch_pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop(drain=exc[0] is None)
+        return False
+
+    def _loop(self):
+        try:
+            while True:
+                with self._work:
+                    if self._fatal is not None or self._stop_mode == "now":
+                        break
+                    idle = not self._queue and all(
+                        r is None for r in self._slots)
+                    if idle:
+                        if self._stop_mode == "drain":
+                            break
+                        self._work.wait(0.05)
+                        continue
+                try:
+                    self.step()
+                except Exception as e:  # noqa: BLE001 — record, not die
+                    self._handle_fatal(e)
+                    break
+        finally:
+            # A stop() whose join timed out leaves _thread set (so a
+            # concurrent start() can't double-drive the slot table);
+            # once the loop really exits, release the handle so start()
+            # can re-arm the engine.
+            with self._lock:
+                if self._thread is threading.current_thread():
+                    self._thread = None
+
+    # -- refill -----------------------------------------------------------
+    def _refill(self) -> int:
+        admitted = 0
+        while True:
+            with self._work:
+                free = [s for s, r in enumerate(self._slots) if r is None]
+                if not free or not self._queue:
+                    break
+                req = self._queue.popleft()
+                slot = min(free)  # deterministic: lowest free slot, FIFO
+                self._slots[slot] = req
+                depth = len(self._queue)
+                self._work.notify_all()  # queue space freed
+            admitted += 1
+            req.t_admit = time.time()
+            req.slot = slot
+            self._metric("gauge", "serving_queue_depth", depth)
+            wait_s = req.t_admit - req.t_submit
+            events.completed_span("serve_queue", wait_s, request=req.id)
+            self._metric("histogram", "serving_queue_wait_s", wait_s)
+            if not self._prefill_with_retries(req, slot):
+                with self._work:
+                    self._slots[slot] = None
+                    self._work.notify_all()
+        return admitted
+
+    def _prefill_with_retries(self, req: Request, slot: int) -> bool:
+        last: BaseException | None = None
+        for attempt in range(self.retries + 1):
+            try:
+                with events.span("serve_prefill", request=req.id, slot=slot,
+                                 bucket=req.bucket, rows=1):
+                    first = self._timed(
+                        lambda: self.backend.prefill(slot, req.prompt,
+                                                     req.bucket),
+                        "prefill")
+                self.stats["prefills"] += 1
+                if req.state == FAILED:
+                    # The engine failed over (stop(drain=False) / fatal)
+                    # while this prefill was in flight: the request was
+                    # already reported failed — never resurrect it to
+                    # RUNNING or stream a token after the failure.
+                    return False
+                req.state = RUNNING
+                req.t_decode_start = time.time()
+                self._deliver(req, int(first))
+                return True
+            except ServingStallError:
+                raise  # a wedged device is never a per-request fault
+            except Exception as e:  # noqa: BLE001 — per-request isolation
+                if getattr(e, "serving_fatal", False):
+                    # e.g. backend.SlotCacheLost: the donated cache was
+                    # consumed by the failing call — retrying reads a
+                    # deleted buffer, so fail over instead of evicting
+                    # innocent requests one by one.
+                    self._handle_fatal(e)
+                    raise
+                last = e
+                req.failures += 1
+                if attempt < self.retries:
+                    self.stats["prefill_retries"] += 1
+                    events.event("serve_prefill_retry", request=req.id,
+                                 attempt=attempt + 1,
+                                 error=f"{type(e).__name__}: {e}"[:200])
+        self._quarantine(req, last)
+        return False
+
+    def _quarantine(self, req: Request, cause: BaseException | None):
+        req.state = FAILED
+        req.finish_reason = "error"
+        req.error = RequestQuarantined(
+            f"request {req.id} quarantined after {req.failures} "
+            f"failure(s): {type(cause).__name__ if cause else '?'}: "
+            f"{cause}")
+        req.error.__cause__ = cause
+        req.t_done = time.time()
+        self.stats["quarantined"] += 1
+        events.event("serve_request_quarantined", request=req.id,
+                     failures=req.failures,
+                     error=f"{type(cause).__name__}: {cause}"[:200]
+                     if cause else "?")
+        self._metric("counter", "serving_requests_quarantined_total")
+        req._done.set()
+
+    # -- decode step ------------------------------------------------------
+    def _step_with_isolation(self):
+        """Run one backend decode step with the PR 4 retry posture:
+        transient failures retry; past the budget the newest-admitted
+        request (the slot-table state that changed most recently — the
+        suspect) is evicted + quarantined and the step retried, so a
+        poisoned request takes itself out, not the gang. Returns the
+        per-slot token list, or None when every request was evicted."""
+        attempts = 0
+        while True:
+            with self._lock:
+                slots = sorted(s for s, r in enumerate(self._slots)
+                               if r is not None and r.state == RUNNING)
+            if not slots:
+                # Every running request was evicted (each already
+                # quarantined with its cause): the engine stays alive
+                # and keeps serving the queue — a sole poisoned
+                # occupant must not take the gang down any more than a
+                # co-resident one does. A genuinely broken backend
+                # degrades per-request (each new refill burns its own
+                # retry budget and quarantines), never engine-fatally.
+                return None
+            try:
+                return self._timed(lambda: self.backend.step(slots),
+                                   "decode_step")
+            except ServingStallError:
+                raise
+            except Exception as e:  # noqa: BLE001 — retry taxonomy below
+                if getattr(e, "serving_fatal", False):
+                    self._handle_fatal(e)
+                    raise
+                attempts += 1
+                if attempts <= self.retries:
+                    self.stats["step_retries"] += 1
+                    events.event("serve_step_retry", attempt=attempts,
+                                 error=f"{type(e).__name__}: {e}"[:200])
+                    continue
+                with self._lock:
+                    running = [r for r in self._slots
+                               if r is not None and r.state == RUNNING]
+                    victim = max(running, key=lambda r: r.t_admit or 0.0) \
+                        if running else None
+                    if victim is not None:
+                        self._slots[victim.slot] = None
+                if victim is not None:
+                    # Same release step as a normal retirement: the
+                    # backend parks the evicted slot (a release()-ful
+                    # backend must never leak one slot per eviction).
+                    self._release_slot(victim.slot)
+                    self._quarantine(victim, e)
+                attempts = 0
+
+    def _deliver(self, req: Request, tok: int):
+        req.tokens.append(tok)
+        self.stats["tokens_out"] += 1
+        self._metric("counter", "serving_tokens_total")
+        now = time.time()
+        if req.t_first_token is None:
+            req.t_first_token = now
+            self._metric("histogram", "serving_ttft_s",
+                         now - req.t_submit)
+        if req.stream_cb is not None:
+            try:
+                req.stream_cb(req, tok)
+            except Exception:  # noqa: BLE001 — a client callback must
+                self.stats["callback_errors"] += 1  # never kill the loop
+                log.exception("serve stream callback failed (request %s)",
+                              req.id)
+        if self.eos_id is not None and tok == self.eos_id:
+            self._retire(req, "eos")
+        elif len(req.tokens) >= req.max_new_tokens:
+            self._retire(req, "length")
+
+    def _release_slot(self, slot: int | None):
+        if slot is None:
+            return
+        release = getattr(self.backend, "release", None)
+        if release is not None:
+            try:
+                release(slot)
+            except Exception:  # noqa: BLE001 — cleanup must not mask
+                log.exception("backend.release(%s) failed", slot)
+
+    def _retire(self, req: Request, reason: str):
+        with self._work:
+            if req.slot is not None and self._slots[req.slot] is req:
+                self._slots[req.slot] = None
+            self._work.notify_all()
+        self._release_slot(req.slot)
+        req.state = DONE
+        req.finish_reason = reason
+        req.t_done = time.time()
+        self.stats["completed"] += 1
+        decode_s = req.t_done - getattr(req, "t_decode_start", req.t_admit)
+        events.completed_span("serve_decode", decode_s, request=req.id,
+                              rows=len(req.tokens), reason=reason)
+        self._metric("counter", "serving_requests_completed_total")
+        self._metric("histogram", "serving_request_latency_s",
+                     req.t_done - req.t_submit)
+        req._done.set()
+
+    # -- failure plumbing -------------------------------------------------
+    def _timed(self, fn, stage: str):
+        """Run one backend call under the optional stall watchdog."""
+        if not self.stall_s or self.stall_s <= 0:
+            return fn()
+        if self._watch_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._watch_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="sparkdl-serve-backend")
+        fut = self._watch_pool.submit(fn)
+        from concurrent.futures import TimeoutError as FutTimeout
+        try:
+            return fut.result(timeout=self.stall_s)
+        except FutTimeout:
+            err = ServingStallError(
+                f"serving {stage} exceeded SPARKDL_SERVE_STALL_S="
+                f"{self.stall_s:g}s")
+            self._handle_fatal(err)
+            raise err from None
+
+    def _handle_fatal(self, exc: BaseException):
+        # Idempotent: a stall surfaces through both _timed and the
+        # background loop's catch — one failure must record ONE
+        # serve_engine_fatal event and run _fail_pending once.
+        with self._lock:
+            if self._fatal is not None:
+                return
+            self._fatal = exc
+        events.event("serve_engine_fatal",
+                     error=f"{type(exc).__name__}: {exc}"[:300])
+        self._fail_pending(EngineStopped(
+            f"engine died: {type(exc).__name__}: {exc}"))
+
+    def _fail_pending(self, err: EngineStopped):
+        with self._work:
+            pending = list(self._queue)
+            self._queue.clear()
+            for s, r in enumerate(self._slots):
+                if r is not None:
+                    pending.append(r)
+                    self._slots[s] = None
+            self._work.notify_all()
+        for req in pending:
+            if req.state in (DONE, FAILED):
+                continue
+            req.state = FAILED
+            req.finish_reason = "error"
+            req.error = err
+            req.t_done = time.time()
+            self.stats["failed"] += 1
+            req._done.set()
+
+    # -- introspection ----------------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "queue_depth": len(self._queue),
+                "slots_busy": sum(r is not None for r in self._slots),
+                "num_slots": len(self._slots),
+                **dict(self.stats),
+            }

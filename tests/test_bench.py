@@ -100,6 +100,12 @@ def test_all_metric_legs_run_end_to_end_tiny_cpu():
                 "BENCH_GEN_NEW": "8", "BENCH_FLASH_SEQS": "256",
                 "BENCH_GEN_LC_PROMPT": "8", "BENCH_GEN_LC_CACHE": "256",
                 "BENCH_GEN_LC_NEW": "4",
+                # serve leg (ISSUE 8) at smoke scale: the leg BODY must
+                # run, compile-free steady state and token identity are
+                # asserted below; the >=3x speedup is a bench-record
+                # criterion, not a tiny-CPU one
+                "BENCH_SERVE_REQUESTS": "32", "BENCH_SERVE_SLOTS": "4",
+                "BENCH_SERVE_CONCURRENCY": "1,8",
                 # the train leg compiles TWO signatures per swept batch
                 # size since the uint8-streamed variant landed — the old
                 # 480s/900s budgets left it no headroom on a loaded host
@@ -114,8 +120,18 @@ def test_all_metric_legs_run_end_to_end_tiny_cpu():
     assert not errs, {k: extra[k] for k in errs}
     for key in ("mfu", "featurizer_rows_per_sec", "featurizer_breakdown",
                 "inference", "bert_tokens_s_chip", "gen_e2e_tokens_s",
-                "flash", "host_ingest"):
+                "flash", "host_ingest", "serving", "serve_tokens_s"):
         assert key in extra, f"leg output missing {key}: {sorted(extra)}"
+    # serving leg (ISSUE 8): engine legs + static comparator recorded,
+    # the decode step never re-traced after warmup, greedy continuous
+    # batching token-identical to the static path
+    sv = extra["serving"]
+    assert extra["serve_tokens_s"] and extra["serve_tokens_s"] > 0
+    assert sv["static"]["tokens_s"] > 0
+    assert sv["decode_retrace_after_warmup"] == 0, sv
+    assert sv["token_identical_spot_check"] is True
+    assert all(leg["completed"] == leg["requests"]
+               for leg in sv["engine"].values()), sv["engine"]
     # backend-free ingest leg (ISSUE 7): a real host-side number with
     # before/after deltas — the record that survives TPU outages
     hi = extra["host_ingest"]

@@ -77,6 +77,30 @@ def test_left_pad_rows_are_excluded():
     assert not np.allclose(got[3], unpadded[3], atol=1e-3)
 
 
+def test_per_row_cur_matches_per_row_dense():
+    """``cur`` as a [B] vector (the continuous-batching slot cache —
+    every row at its own fill level) must equal running each row through
+    the dense reference with its own scalar cur."""
+    b, h_kv, rep, max_len, d = 4, 2, 2, 384, 16
+    ks = jax.random.split(jax.random.PRNGKey(21), 3)
+    q = _rand(ks[0], (b, h_kv * rep, 1, d))
+    k = _rand(ks[1], (b, h_kv, max_len, d))
+    v = _rand(ks[2], (b, h_kv, max_len, d))
+    cur = jnp.array([5, 130, 260, 384], jnp.int32)
+    pad = jnp.array([0, 3, 10, 100], jnp.int32)
+    got = flash_decode(q, k, v, cur, pad, interpret=True)
+    want = jnp.concatenate([
+        dense_cache_attention(q[r:r + 1], k[r:r + 1], v[r:r + 1],
+                              int(cur[r]), pad[r:r + 1])
+        for r in range(b)], axis=0)
+    np.testing.assert_allclose(got, want, atol=ATOL, rtol=RTOL)
+    # rows genuinely differ from a shared-cur run (the mask is per-row)
+    shared = flash_decode(q, k, v, jnp.int32(384), pad, interpret=True)
+    assert not np.allclose(got[0], shared[0], atol=1e-3)
+    with pytest.raises(ValueError, match="scalar or"):
+        flash_decode(q, k, v, jnp.zeros((2,), jnp.int32), interpret=True)
+
+
 def test_bf16_io_f32_accumulation():
     b, h_kv, rep, max_len, d = 2, 2, 4, 128, 32
     ks = jax.random.split(jax.random.PRNGKey(3), 3)

@@ -52,7 +52,12 @@ def test_resnet50_shapes_and_feature_dim():
                                   np.asarray(feat_fn(variables, x)))
 
 
+@pytest.mark.slow
 def test_inception_v3_full_size_bottleneck():
+    # Full-size 299x299 InceptionV3 forward: ~30s of tier-1 budget for a
+    # numerical-sanity proof — behind the slow marker (ISSUE 8 headroom
+    # satellite); the architecture itself is covered by the shape and
+    # param-count tests.
     m = get_model("InceptionV3")
     variables = m.init_params(seed=0)
     fn = jax.jit(m.apply_fn(features_only=True))
@@ -64,13 +69,17 @@ def test_inception_v3_full_size_bottleneck():
 
 def test_param_counts_sane():
     # ResNet50 ≈ 25.6M params; InceptionV3 ≈ 23.9M (with heads).
-    def count(vs):
+    # Shape-only: eval_shape traces init without computing a single
+    # weight (the old full inits cost ~37s of tier-1 budget for a
+    # number that only depends on shapes).
+    def count(model):
+        shapes = jax.eval_shape(lambda: model.init_params(seed=0))
         return sum(int(np.prod(p.shape))
-                   for p in jax.tree_util.tree_leaves(vs["params"]))
+                   for p in jax.tree_util.tree_leaves(shapes["params"]))
 
-    rn = count(get_model("ResNet50").init_params())
+    rn = count(get_model("ResNet50"))
     assert 25_000_000 < rn < 26_500_000, rn
-    iv = count(get_model("InceptionV3").init_params())
+    iv = count(get_model("InceptionV3"))
     assert 23_000_000 < iv < 24_500_000, iv
 
 

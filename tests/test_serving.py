@@ -1,0 +1,453 @@
+"""Continuous-batching serving engine (ISSUE 8).
+
+Three layers, leanest first: jax-free scheduler unit tests over
+scripted backends (refill ordering, admission control, EOS retirement,
+streaming callback order, per-request quarantine, stall watchdog),
+device-free telemetry plumbing (histogram quantiles + gang
+aggregation), then ONE engine-on-CPU equivalence test over
+``LlamaConfig.tiny`` (slot prefill/decode + staggered refill must be
+token-identical to the static ``generate()`` path) and the slow
+serve-smoke e2e.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu.runner import telemetry
+from sparkdl_tpu.serving import (EngineStopped, GenerationEngine,
+                                 QueueFullError, RequestQuarantined,
+                                 RequestRejected, ServingStallError,
+                                 StubBackend, bucket_length)
+
+
+class RecordingBackend(StubBackend):
+    """Stub that records the (prompt, slot) order of every prefill —
+    the scheduler-ordering observable."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.prefill_log: list[tuple[tuple, int]] = []
+
+    def prefill(self, slot, prompt, bucket):
+        self.prefill_log.append((tuple(prompt), slot))
+        return super().prefill(slot, prompt, bucket)
+
+
+# ---------------------------------------------------------------------------
+# jax-free scheduler unit tests
+# ---------------------------------------------------------------------------
+
+class TestScheduler:
+    def test_fifo_refill_order_lowest_slot_first(self):
+        be = RecordingBackend(2, 64, vocab_size=100)
+        eng = GenerationEngine(be)
+        reqs = [eng.submit([i, i + 1], max_new_tokens=3) for i in range(5)]
+        eng.run_until_idle()
+        # admitted strictly in submission order
+        assert [p for p, _ in be.prefill_log] == \
+            [tuple(r.prompt) for r in reqs]
+        # first two land on slots 0 and 1 (lowest free slot first)
+        assert [s for _, s in be.prefill_log[:2]] == [0, 1]
+        for r in reqs:
+            assert r.result(1) and r.finish_reason == "length"
+        assert eng.snapshot()["completed"] == 5
+
+    def test_requests_overlap_across_slots(self):
+        """A freed slot refills while the other slot's request is still
+        decoding — the batch never drains."""
+        be = StubBackend(2, 64, vocab_size=100)
+        eng = GenerationEngine(be)
+        long = eng.submit([1], max_new_tokens=12)
+        short = eng.submit([2], max_new_tokens=2)
+        third = eng.submit([3], max_new_tokens=2)
+        eng.run_until_idle()
+        # third was admitted into short's freed slot BEFORE long retired
+        assert third.t_admit < long.t_done
+        assert eng.snapshot()["peak_slots_busy"] == 2
+        assert all(r.state == "done" for r in (long, short, third))
+
+    def test_stream_callback_order_first_token_included(self):
+        per_req: dict = {}
+        be = StubBackend(2, 64, vocab_size=100)
+        eng = GenerationEngine(be)
+        reqs = [eng.submit([i + 1, 7], max_new_tokens=4,
+                           stream_cb=lambda r, t:
+                           per_req.setdefault(r.id, []).append(t))
+                for i in range(3)]
+        eng.run_until_idle()
+        for r in reqs:
+            assert per_req[r.id] == r.result(1)  # every token, in order
+            assert len(per_req[r.id]) == 4
+
+    def test_broken_callback_never_kills_the_loop(self):
+        def boom(r, t):
+            raise RuntimeError("client bug")
+        eng = GenerationEngine(StubBackend(1, 64, vocab_size=100))
+        r = eng.submit([1], max_new_tokens=3, stream_cb=boom)
+        eng.run_until_idle()
+        assert r.result(1) and eng.snapshot()["callback_errors"] == 3
+
+    def test_eos_retires_slot_early(self):
+        class EosAt2(StubBackend):
+            def _tok(self, key, n):
+                return 9 if n == 2 else (key + n) % self.vocab_size
+
+        eng = GenerationEngine(EosAt2(1, 64, vocab_size=100), eos_id=9)
+        r = eng.submit([5], max_new_tokens=40)
+        eng.run_until_idle()
+        out = r.result(1)
+        assert out[-1] == 9 and len(out) == 3  # eos included, then stop
+        assert r.finish_reason == "eos"
+
+    def test_admission_rejects(self):
+        eng = GenerationEngine(StubBackend(2, 64, vocab_size=100),
+                               min_bucket=8)
+        with pytest.raises(RequestRejected, match="empty"):
+            eng.submit([], max_new_tokens=4)
+        with pytest.raises(RequestRejected, match="outside vocab"):
+            eng.submit([5, 100], max_new_tokens=4)
+        with pytest.raises(RequestRejected, match="exceeds max_len"):
+            eng.submit(list(range(1, 40)), max_new_tokens=32)  # 64+32>64
+        with pytest.raises(RequestRejected, match="max_new_tokens"):
+            eng.submit([1], max_new_tokens=0)
+        assert eng.snapshot()["rejected"] == 4
+
+    def test_queue_backpressure(self):
+        eng = GenerationEngine(StubBackend(1, 64, vocab_size=100),
+                               queue_capacity=1)
+        eng.submit([1], max_new_tokens=2)
+        with pytest.raises(QueueFullError):
+            eng.submit([2], max_new_tokens=2, block=False)
+        with pytest.raises(QueueFullError):
+            eng.submit([2], max_new_tokens=2, timeout=0.05)
+        snap = eng.snapshot()
+        assert snap["rejected"] == 2 and snap["queue_depth"] == 1
+        eng.run_until_idle()
+        # space freed -> accepted again
+        assert eng.submit([3], max_new_tokens=2, block=False)
+        eng.run_until_idle()
+
+    def test_prefill_retry_then_success(self):
+        class FlakyOnce(StubBackend):
+            def __init__(self, *a, **kw):
+                super().__init__(*a, **kw)
+                self.fails = 0
+
+            def prefill(self, slot, prompt, bucket):
+                if prompt[0] == 42 and self.fails == 0:
+                    self.fails += 1
+                    raise RuntimeError("transient")
+                return super().prefill(slot, prompt, bucket)
+
+        eng = GenerationEngine(FlakyOnce(1, 64, vocab_size=100), retries=1)
+        r = eng.submit([42], max_new_tokens=3)
+        eng.run_until_idle()
+        assert r.result(1) and r.failures == 1
+        assert eng.snapshot()["prefill_retries"] == 1
+
+    def test_prefill_quarantine_after_repeated_failure(self):
+        class Poison(StubBackend):
+            def prefill(self, slot, prompt, bucket):
+                if prompt[0] == 99:
+                    raise RuntimeError("bad prompt payload")
+                return super().prefill(slot, prompt, bucket)
+
+        eng = GenerationEngine(Poison(2, 64, vocab_size=100), retries=2)
+        good = eng.submit([1, 2], max_new_tokens=4)
+        bad = eng.submit([99], max_new_tokens=4)
+        also_good = eng.submit([3], max_new_tokens=4)
+        eng.run_until_idle()
+        # the poisoned request is evicted, not the gang
+        assert good.result(1) and also_good.result(1)
+        assert bad.state == "failed" and bad.failures == 3
+        with pytest.raises(RequestQuarantined):
+            bad.result(1)
+        snap = eng.snapshot()
+        assert snap["quarantined"] == 1 and snap["completed"] == 2
+
+    def test_step_failure_evicts_newest_suspect(self):
+        class StepPoison(StubBackend):
+            def step(self, active):
+                # key = sum(prompt) + len(prompt); [99] -> 100
+                if any(self._state[s][0] == 100 for s in active):
+                    raise RuntimeError("poisoned decode")
+                return super().step(active)
+
+        eng = GenerationEngine(StepPoison(2, 64, vocab_size=200),
+                               retries=1)
+        survivor = eng.submit([1, 2], max_new_tokens=6)
+        poison = eng.submit([99], max_new_tokens=6)
+        eng.run_until_idle()
+        assert survivor.result(1) and survivor.finish_reason == "length"
+        assert poison.state == "failed"
+        snap = eng.snapshot()
+        assert snap["quarantined"] == 1 and snap["step_retries"] >= 1
+
+    def test_sole_occupant_eviction_keeps_engine_alive(self):
+        """A poisoned request that is the ONLY one in flight is evicted
+        exactly like a co-resident one — the engine survives and keeps
+        serving the queue (eviction must never be gang-fatal)."""
+        class StepPoison(StubBackend):
+            def step(self, active):
+                if any(self._state[s][0] == 100 for s in active):  # [99]
+                    raise RuntimeError("poisoned decode")
+                return super().step(active)
+
+        eng = GenerationEngine(StepPoison(2, 64, vocab_size=200),
+                               retries=1)
+        poison = eng.submit([99], max_new_tokens=6)  # alone in flight
+        eng.run_until_idle()
+        assert poison.state == "failed"
+        assert eng.snapshot()["quarantined"] == 1
+        # engine alive: a new request completes normally
+        after = eng.submit([1, 2], max_new_tokens=4)
+        eng.run_until_idle()
+        assert after.result(1) and after.finish_reason == "length"
+
+    def test_serving_fatal_error_skips_retry_and_fails_over(self):
+        """An error flagged ``serving_fatal`` (backend.SlotCacheLost:
+        the donated cache was consumed — retrying would read a deleted
+        buffer) must fail the engine over immediately: no retry burned,
+        no innocent requests evicted one by one."""
+        class CacheGone(RuntimeError):
+            serving_fatal = True
+
+        class LostCache(StubBackend):
+            def step(self, active):
+                raise CacheGone("cache consumed mid-execution")
+
+        eng = GenerationEngine(LostCache(2, 64, vocab_size=100),
+                               retries=3)
+        a = eng.submit([1], max_new_tokens=5)
+        b = eng.submit([2], max_new_tokens=5)
+        with pytest.raises(CacheGone):
+            eng.run_until_idle()
+        snap = eng.snapshot()
+        assert snap["step_retries"] == 0 and snap["quarantined"] == 0
+        for r in (a, b):
+            assert r.state == "failed" and \
+                isinstance(r.error, EngineStopped)
+        with pytest.raises(EngineStopped):
+            eng.submit([3], max_new_tokens=2)
+
+    def test_stall_watchdog_names_stage_and_fails_pending(self):
+        class Wedged(StubBackend):
+            def step(self, active):
+                time.sleep(3)
+                return super().step(active)
+
+        eng = GenerationEngine(Wedged(1, 64, vocab_size=100), stall_s=0.2)
+        r = eng.submit([1], max_new_tokens=5)
+        with pytest.raises(ServingStallError, match="decode_step"):
+            eng.run_until_idle()
+        assert r.state == "failed" and isinstance(r.error, EngineStopped)
+
+    def test_stop_now_fails_pending_drain_completes(self):
+        eng = GenerationEngine(StubBackend(1, 64, vocab_size=100,
+                                           step_s=0.002)).start()
+        rs = [eng.submit([i + 1], max_new_tokens=4) for i in range(4)]
+        eng.stop(drain=True, timeout=30)
+        assert all(r.state == "done" for r in rs)
+        eng2 = GenerationEngine(StubBackend(1, 64, vocab_size=100,
+                                            step_s=0.05)).start()
+        rs2 = [eng2.submit([i + 1], max_new_tokens=40) for i in range(3)]
+        eng2.stop(drain=False, timeout=30)
+        assert any(r.state == "failed" and
+                   isinstance(r.error, EngineStopped) for r in rs2)
+        with pytest.raises(EngineStopped):
+            eng2.submit([9], max_new_tokens=2)
+
+    def test_concurrent_submitters_all_complete(self):
+        eng = GenerationEngine(StubBackend(4, 64, vocab_size=100),
+                               queue_capacity=8).start()
+        handles, hlock = [], threading.Lock()
+
+        def client(base):
+            for i in range(6):
+                h = eng.submit([base, i + 1], max_new_tokens=3)
+                with hlock:
+                    handles.append(h)
+                h.result(timeout=30)
+
+        threads = [threading.Thread(target=client, args=(b,))
+                   for b in (1, 2, 3, 4, 5, 6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        eng.stop(drain=True, timeout=30)
+        assert len(handles) == 36
+        assert all(h.state == "done" for h in handles)  # nothing starves
+
+    def test_queue_capacity_floor(self):
+        # capacity 0 would make every blocking submit spin forever
+        eng = GenerationEngine(StubBackend(1, 64, vocab_size=100),
+                               queue_capacity=0)
+        assert eng.queue_capacity == 1
+        assert eng.submit([1], max_new_tokens=2)
+        eng.run_until_idle()
+
+    def test_bucket_length_contract(self):
+        assert bucket_length(1, 8) == 8
+        assert bucket_length(8, 8) == 8
+        assert bucket_length(9, 8) == 16
+        assert bucket_length(33, 8) == 64
+        with pytest.raises(ValueError):
+            bucket_length(0, 8)
+
+
+# ---------------------------------------------------------------------------
+# telemetry plumbing (jax-free)
+# ---------------------------------------------------------------------------
+
+class TestServingTelemetry:
+    def test_histogram_quantile_math(self):
+        h = {"bounds": [1.0, 2.0, 4.0], "buckets": [2, 6, 8],
+             "count": 8, "sum": 0.0}
+        # rank p50 = 4 -> second bucket, interp (4-2)/(6-2) of [1,2]
+        assert telemetry.histogram_quantile(h, 0.5) == pytest.approx(1.5)
+        assert telemetry.histogram_quantile(h, 0.0) == pytest.approx(0.0)
+        assert telemetry.histogram_quantile(h, 1.0) == pytest.approx(4.0)
+        # rank past the last finite bound clamps to it
+        h2 = {"bounds": [1.0], "buckets": [1], "count": 10, "sum": 0.0}
+        assert telemetry.histogram_quantile(h2, 0.99) == 1.0
+        assert telemetry.histogram_quantile(
+            {"bounds": [], "buckets": [], "count": 0}, 0.5) is None
+        # the live-histogram method rides the same derivation
+        hist = telemetry.Histogram(buckets=(1.0, 2.0))
+        for v in (0.5, 1.5, 1.7):
+            hist.observe(v)
+        assert hist.quantile(1.0) == pytest.approx(2.0)
+
+    def test_aggregate_snapshots_merges_histograms(self, tmp_path):
+        import json
+        snap = {"t": 1.0, "elapsed_s": 1.0, "stages": {},
+                "histograms": {"serving_request_latency_s": {
+                    "bounds": [1.0, 2.0], "buckets": [1, 2],
+                    "count": 2, "sum": 2.5}}}
+        for rank in (0, 1):
+            (tmp_path / f"metrics_rank{rank}.json").write_text(
+                json.dumps(dict(snap, rank=rank)))
+        agg = telemetry.aggregate_snapshots(str(tmp_path))
+        h = agg["histograms"]["serving_request_latency_s"]
+        assert h["buckets"] == [2, 4] and h["count"] == 4
+        assert h["sum"] == pytest.approx(5.0)
+        assert telemetry.histogram_quantile(h, 0.5) is not None
+
+    def test_engine_metrics_when_plane_armed(self):
+        telemetry.reset()
+        telemetry.start()
+        try:
+            eng = GenerationEngine(StubBackend(2, 64, vocab_size=100))
+            rs = [eng.submit([i + 1], max_new_tokens=3) for i in range(4)]
+            eng.run_until_idle()
+            assert all(r.state == "done" for r in rs)
+            snap = telemetry.registry().snapshot()
+            assert snap["counters"]["serving_tokens_total"] == 12
+            assert snap["counters"][
+                "serving_requests_completed_total"] == 4
+            assert snap["gauges"]["serving_queue_depth"]["max"] >= 1
+            assert snap["gauges"]["serving_slots_busy"]["max"] == 2
+            lat = snap["histograms"]["serving_request_latency_s"]
+            assert lat["count"] == 4
+            assert telemetry.histogram_quantile(lat, 0.5) is not None
+            assert snap["histograms"]["serving_ttft_s"]["count"] == 4
+        finally:
+            telemetry.reset()
+
+    def test_engine_registers_nothing_when_plane_off(self):
+        telemetry.reset()
+        eng = GenerationEngine(StubBackend(1, 64, vocab_size=100))
+        eng.submit([1], max_new_tokens=2)
+        eng.run_until_idle()
+        assert telemetry.registry().snapshot() == {
+            "counters": {}, "gauges": {}, "histograms": {}}
+
+    def test_request_spans_reach_flight_recorder(self):
+        from sparkdl_tpu.runner import events
+        rec = events.reset()
+        eng = GenerationEngine(StubBackend(1, 64, vocab_size=100))
+        r = eng.submit([1], max_new_tokens=2)
+        eng.run_until_idle()
+        names = [e["name"] for e in rec.ring]
+        for span in ("serve_queue", "serve_prefill", "serve_decode"):
+            assert f"{span}" in names, names
+        ends = [e for e in rec.ring
+                if e["ph"] == "E" and e["name"] == "serve_decode"]
+        assert ends and ends[0]["request"] == r.id
+        assert ends[0]["rows"] == 2
+
+
+# ---------------------------------------------------------------------------
+# engine on CPU over the tiny model (lean: one compile set, one test)
+# ---------------------------------------------------------------------------
+
+class TestEngineOnCpu:
+    def test_token_identical_with_staggered_refill_and_eos(self):
+        """Mixed-length requests through a 2-slot engine emit exactly
+        the static generate() greedy tokens — including a request
+        refilled mid-decode into a retired slot (different bucket), and
+        EOS retirement behaving like generate()'s while_loop."""
+        import jax
+
+        from sparkdl_tpu.models import llama as L
+
+        cfg = L.LlamaConfig.tiny()
+        model = L.LlamaModel(cfg)
+        variables = model.init(jax.random.PRNGKey(0),
+                               np.zeros((1, 4), np.int32))
+        rng = np.random.RandomState(3)
+        prompts = [rng.randint(0, cfg.vocab_size, n).tolist()
+                   for n in (5, 2, 9, 3)]  # buckets 8 and 16
+        max_len = 64
+
+        def ref(prompt, new, eos=None):
+            ids, lens = L.left_pad_prompts([prompt])
+            out = L.generate(model, variables, np.asarray(ids), new,
+                             pad_lens=np.asarray(lens), pad_to=max_len,
+                             eos_id=eos)
+            row = np.asarray(out)[0][int(lens[0]) + len(prompt):]
+            toks = row.tolist()
+            if eos is not None and eos in toks:
+                toks = toks[:toks.index(eos) + 1]
+            return toks
+
+        eng = GenerationEngine.from_model(model, variables, num_slots=2,
+                                          max_len=max_len, min_bucket=8)
+        handles = [eng.submit(p, max_new_tokens=6) for p in prompts]
+        eng.run_until_idle()
+        snap = eng.snapshot()
+        assert snap["peak_slots_busy"] == 2  # genuinely in-flight
+        for p, h in zip(prompts, handles):
+            assert h.result(1) == ref(p, 6), p
+
+        # EOS: pick a token the greedy stream emits mid-sequence, serve
+        # with it as eos_id — engine must stop exactly where the static
+        # while_loop path stops.
+        stream = ref(prompts[0], 6)
+        eos = next((t for i, t in enumerate(stream) if 0 < i < 5), None)
+        if eos is not None:
+            eng2 = GenerationEngine.from_model(
+                model, variables, num_slots=2, max_len=max_len,
+                min_bucket=8, eos_id=int(eos))
+            h = eng2.submit(prompts[0], max_new_tokens=6)
+            eng2.run_until_idle()
+            assert h.result(1) == ref(prompts[0], 6, eos=int(eos))
+            assert h.finish_reason in ("eos", "length")
+
+
+@pytest.mark.slow
+def test_serve_smoke_end_to_end():
+    """Concurrent submitters, no starvation, aggregate > single-stream,
+    zero decode re-traces (scripts/serve_smoke.py, in-process)."""
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "serve_smoke", os.path.join(os.path.dirname(__file__), "..",
+                                    "scripts", "serve_smoke.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main() == 0
