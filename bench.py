@@ -1122,6 +1122,27 @@ def _worker_serve_stub() -> dict:
     return _load_script_module("serve_bench.py").run(mode="stub")
 
 
+def _serve_headline(serve: dict) -> dict:
+    """The ISSUE 8/10 headline numbers pulled from a serve-bench record:
+    aggregate tokens/s at the highest measured concurrency, prefix-cache
+    hit rate and prefill-induced decode-stall seconds right next to it
+    (the stall-free scheduler's before/after must be readable without
+    digging into the legs), and the stall-free-vs-blocking ratios. Used
+    by BOTH the healthy-backend record and the backend_unavailable
+    error record."""
+    top = max((serve.get("engine") or {}).items(),
+              key=lambda kv: int(kv[0]), default=(None, {}))[1]
+    out = {"serve_tokens_s": top.get("tokens_s"),
+           "serve_decode_stall_s": top.get("decode_stall_s"),
+           "serve_prefix_cache_hit_rate":
+               (top.get("prefix_cache") or {}).get("hit_rate")}
+    for k in ("speedup_vs_blocking", "ttft_p99_ratio",
+              "decode_stall_ratio"):
+        if serve.get(k) is not None:
+            out[f"serve_{k}"] = serve[k]
+    return out
+
+
 _WORKERS = {"resnet50_train": _worker_resnet50_train,
             "host_ingest": _worker_host_ingest,
             "featurizer": _worker_featurizer,
@@ -1410,6 +1431,7 @@ def main():
             err_extra["serving_stub_error"] = stub_err
         if serve_rec:
             err_extra["serving"] = serve_rec
+            err_extra.update(_serve_headline(serve_rec))
         elif serve_err:
             err_extra["serving_error"] = serve_err
         err_extra["budget"] = {"wall_s": budget.wall_s,
@@ -1499,12 +1521,7 @@ def main():
     elif gen_err:
         extra["gen_error"] = gen_err
     if serve:
-        # The ISSUE 8 record: serve_tokens_s = aggregate engine tokens/s
-        # at the highest measured concurrency, next to the static
-        # whole-batch comparator and the re-trace pin.
-        top = max((serve.get("engine") or {}).items(),
-                  key=lambda kv: int(kv[0]), default=(None, {}))[1]
-        extra["serve_tokens_s"] = top.get("tokens_s")
+        extra.update(_serve_headline(serve))
         extra["serving"] = serve
     elif serve_err:
         extra["serving_error"] = serve_err

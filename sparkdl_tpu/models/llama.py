@@ -737,6 +737,80 @@ def prefill_into_slot(model, params, prompt_ids, pad_len, cache, slot, rng,
 
 
 @functools.partial(
+    jax.jit,
+    static_argnames=("model", "window", "temperature", "top_k", "top_p"),
+    donate_argnames=("cache",))
+def prefill_chunk_into_slot(model, params, chunk_ids, cache, slot, offset,
+                            n_valid, rng, *, window: int | None = None,
+                            temperature: float = 0.0,
+                            top_k: int = 0, top_p: float = 1.0):
+    """Consume ``C`` prompt tokens of ONE request into row ``slot`` at
+    cache positions ``[offset, offset + C)`` — the stall-free serving
+    engine's chunk primitive: a long prompt is fed through this in
+    fixed-size chunks *interleaved with* ``slot_decode_step``, so a
+    refill never monopolizes the device for a whole O(L²) prefill.
+
+    ``chunk_ids``: ``[1, C]`` int32 — the chunked-prefill contract is
+    **zero-aligned** (no left padding: the prompt's token ``i`` lives at
+    cache position ``i``, rope position ``i``), the FINAL chunk
+    right-pads with zeros and ``n_valid`` (traced int32 scalar) names
+    how many of this chunk's tokens are real. The pad tail's K/V rows
+    are written but harmless: causality bounds every real query at or
+    left of itself, and the decode step overwrites position ``L`` first
+    (each write lands before the attention that could read it).
+    ``cache``: the ``[num_slots, ...]`` slot cache (donated); ``slot``/
+    ``offset`` traced, so chunked prefill compiles one program per
+    (C, window) where the bucketed whole-prompt path compiles one per
+    bucket. ``window`` (static, default the full row) bounds how many
+    of the slot's rows the chunk touches: the caller passes the
+    request's chunk-aligned total prompt length, so a 48-token prompt's
+    chunks gather/attend/scatter a 48-row window instead of paying
+    O(C·max_len) attention and full-row copies per chunk — window
+    values are chunk multiples, so the program count stays bounded by
+    max_len/C. Every row the chunk may attend ([0, offset+C)) is inside
+    the window by construction.
+
+    The chunk runs through the model's standard multi-call decode path
+    (write at the fill index, dense attention over the window with the
+    causal-vs-cache mask) against the slot's own row gathered as a B=1
+    cache — attending only to that slot's rows, never the neighbors'.
+    Returns ``(tok [1] int32, cache)`` where ``tok`` is sampled from the
+    logits at the last REAL position — meaningful only on the final
+    chunk (the engine delivers it as the request's first token).
+    """
+    def gather(leaf):
+        # K/V leaves are [slots, Hkv, L, hd]; scalar leaves are the
+        # per-layer ``idx`` fill index — pinned to ``offset`` so the
+        # multi-call decode path writes this chunk at the right rows.
+        if getattr(leaf, "ndim", 0) == 4:
+            w = leaf.shape[2] if window is None \
+                else min(int(window), leaf.shape[2])
+            return jax.lax.dynamic_slice(
+                leaf, (slot, 0, 0, 0),
+                (1, leaf.shape[1], w, leaf.shape[3]))
+        return jnp.asarray(offset, jnp.int32)
+
+    row = jax.tree_util.tree_map(gather, cache)
+    logits, mut = model.apply({"params": params, "cache": row},
+                              chunk_ids, decode=True, mutable=["cache"])
+
+    def scatter(big, sm):
+        if getattr(sm, "ndim", 0) == 4:
+            return jax.lax.dynamic_update_slice(
+                big, sm.astype(big.dtype), (slot, 0, 0, 0))
+        return big  # the shared static-path idx leaf stays as-is
+
+    cache = jax.tree_util.tree_map(scatter, cache, mut["cache"])
+    # Logits at the last REAL token of the chunk (a padded final chunk's
+    # tail logits are garbage); traced index -> one program.
+    last = jax.lax.dynamic_slice(
+        logits, (0, jnp.maximum(n_valid - 1, 0), 0),
+        (1, 1, logits.shape[2]))[:, 0]
+    tok = _sample(last.astype(jnp.float32), rng, temperature, top_k, top_p)
+    return tok, cache
+
+
+@functools.partial(
     jax.jit, static_argnames=("model", "temperature", "top_k", "top_p"),
     donate_argnames=("cache",))
 def slot_decode_step(model, params, cache, tokens, slot_cur, pad_lens, rng,

@@ -6,13 +6,15 @@ telemetry plane); the jax half lives in ``backend`` and is imported
 lazily by :meth:`GenerationEngine.from_model`.
 """
 
-from .engine import (EngineStopped, GenerationEngine, QueueFullError,
-                     Request, RequestQuarantined, RequestRejected,
-                     ServingError, ServingStallError, StubBackend,
-                     bucket_length)
+from .engine import (PREFILLING, EngineStopped, GenerationEngine,
+                     QueueFullError, Request, RequestQuarantined,
+                     RequestRejected, ServingError, ServingStallError,
+                     StubBackend, bucket_length)
+from .prefix import PrefixCache
 
 __all__ = [
     "GenerationEngine", "Request", "StubBackend", "bucket_length",
     "ServingError", "RequestRejected", "QueueFullError",
     "RequestQuarantined", "ServingStallError", "EngineStopped",
+    "PREFILLING", "PrefixCache",
 ]

@@ -1,19 +1,44 @@
 """LlamaSlotBackend — the jax half of the continuous-batching engine.
 
 Owns the device-resident slot cache and the per-slot fill state
-(``cur``/``pad_lens`` vectors), and drives the two jitted slot
-primitives in ``models.llama``:
+(``cur``/``pad_lens`` vectors), and drives the jitted slot primitives
+in ``models.llama``:
 
-- ``prefill_into_slot``: one compiled program per prompt-length
-  *bucket* (``serving.engine.bucket_length``), slot index traced — a
-  refill never re-traces, whatever slot it lands in;
+- ``prefill_into_slot``: the *blocking* whole-prompt refill
+  (``SPARKDL_SERVE_STALL_FREE=0`` fallback) — one compiled program per
+  prompt-length *bucket* (``serving.engine.bucket_length``), slot index
+  traced;
+- ``prefill_chunk_into_slot``: the stall-free chunk primitive — ONE
+  compiled program per (chunk size, num_slots, max_len); the engine
+  interleaves these with decode steps so a long prompt never
+  monopolizes the device (``begin_prefill`` / ``prefill_chunk`` /
+  ``finish_prefill`` below);
 - ``slot_decode_step``: ONE compiled program per (num_slots, max_len)
   for the engine's whole lifetime — the steady-state hot path.
 
-Both signatures are routed through ``GLOBAL_COMPILE_CACHE.note`` so
+All signatures are routed through ``GLOBAL_COMPILE_CACHE.note`` so
 every (re)compilation is a visible flight-recorder ``recompile`` event:
 the serving bench pins "no decode-step re-trace after warmup" on
 exactly that evidence.
+
+**Fill-state invariant (chunked mode).** ``_cur[slot]`` is always the
+slot's *write frontier* — the next cache position a real write will
+land on. ``slot_decode_step`` unconditionally writes every row's
+(masked, discarded) token at its own ``_cur``, so a decode step running
+between two prefill chunks garbage-writes exactly AT the frontier,
+which the next chunk (or the request's own first decode step)
+overwrites before any attention can read it. Parking a mid-prefill
+slot anywhere *below* its frontier would clobber committed prompt K/V.
+
+**Shared-prefix KV reuse.** When ``SPARKDL_SERVE_PREFIX_CACHE_MB`` > 0
+(default 64), every completed chunked prefill commits its prompt's
+K/V rows (chunk-aligned row count, so the copy programs stay bounded)
+into a :class:`serving.prefix.PrefixCache`; ``begin_prefill`` looks the
+new prompt up and, on a hit, scatters the cached rows into the slot
+device-side — the engine then chunk-prefills only the tail. The chunked
+layout is **zero-aligned** (token ``i`` at cache position ``i``, no
+left pad), which is what makes prefix rows position-independent of
+prompt length and chunk count.
 
 Sampling: greedy (``temperature<=0``) is deterministic and
 token-identical to the static ``generate()`` path for the same prompt
@@ -29,8 +54,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import functools
+import logging
+
 from ..core.runtime import GLOBAL_COMPILE_CACHE
 from ..models import llama as L
+from .prefix import (PrefixCache, prefix_cache_budget_bytes,
+                     usable_reuse)
+
+log = logging.getLogger("sparkdl_tpu.serving")
 
 
 class SlotCacheLost(RuntimeError):
@@ -53,6 +85,41 @@ def _tree_sig(tree):
                  for x in jax.tree_util.tree_leaves(tree))
 
 
+@functools.partial(jax.jit, static_argnames=("rows",))
+def _gather_slot_rows(cache, slot, *, rows: int):
+    """Copy ``[0, rows)`` of one slot's K/V rows out of the slot cache —
+    the prefix-cache COMMIT copy. ``rows`` is static (one small copy
+    program per distinct chunk-aligned length — bounded by
+    max_len / chunk); ``slot`` traced. Scalar (``idx``) leaves become
+    structure-preserving placeholders so the payload pytree zips back
+    against the cache at scatter time."""
+    def g(leaf):
+        if getattr(leaf, "ndim", 0) == 4:
+            return jax.lax.dynamic_slice(
+                leaf, (slot, 0, 0, 0),
+                (1, leaf.shape[1], rows, leaf.shape[3]))
+        return jnp.zeros((), jnp.int32)
+
+    return jax.tree_util.tree_map(g, cache)
+
+
+@functools.partial(jax.jit, donate_argnames=("cache",))
+def _scatter_prefix_rows(cache, payload, slot):
+    """Write a cached prefix payload's rows into row ``slot`` at
+    position 0 — the prefix-cache HIT copy (device-side, the cache is
+    donated exactly like the slot primitives). Rows past the payload's
+    real token count are stale entry state: the engine's tail chunks
+    overwrite everything from the reuse point on before attention can
+    reach it (the write-frontier invariant in the module doc)."""
+    def s(big, sm):
+        if getattr(sm, "ndim", 0) == 4:
+            return jax.lax.dynamic_update_slice(
+                big, sm.astype(big.dtype), (slot, 0, 0, 0))
+        return big
+
+    return jax.tree_util.tree_map(s, cache, payload)
+
+
 class LlamaSlotBackend:
     """Slot backend over ``models.llama`` (see module doc).
 
@@ -66,7 +133,8 @@ class LlamaSlotBackend:
 
     def __init__(self, model, variables, num_slots: int, max_len: int, *,
                  temperature: float = 0.0, top_k: int = 0,
-                 top_p: float = 1.0, seed: int = 0):
+                 top_p: float = 1.0, seed: int = 0,
+                 prefix_cache_bytes: int | None = None):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         if max_len < 2:
@@ -82,13 +150,18 @@ class LlamaSlotBackend:
         self.top_p = float(top_p)
         self.cache = L.init_cache(model, self.num_slots, self.max_len)
         self._tokens = np.zeros(self.num_slots, np.int32)
-        # Idle slots park at fill index 0: the step's (masked, discarded)
-        # write lands inside the row and the engine never reads it.
+        # Idle slots park at fill index 0 — their write frontier: the
+        # step's (masked, discarded) write lands exactly where the next
+        # refill's first real write will overwrite it.
         self._cur = np.zeros(self.num_slots, np.int32)
         self._pads = np.zeros(self.num_slots, np.int32)
         self._rng = jax.random.PRNGKey(seed)
         self._step_i = 0
         self._prefill_i = 0
+        budget = prefix_cache_budget_bytes() if prefix_cache_bytes is None \
+            else max(0, int(prefix_cache_bytes))
+        self.prefix_cache = PrefixCache(budget) if budget > 0 else None
+        self._warned_commit = False
 
     # -- engine protocol --------------------------------------------------
     def prefill(self, slot: int, prompt, bucket: int) -> int:
@@ -119,6 +192,108 @@ class LlamaSlotBackend:
         self._cur[slot] = bucket
         self._pads[slot] = int(pad[0])
         return tok
+
+    # -- chunked (stall-free) prefill protocol ----------------------------
+    def begin_prefill(self, slot: int, prompt, chunk: int) -> int:
+        """Arm ``slot`` for a chunked (zero-aligned) prefill. Looks the
+        prompt up in the prefix cache; on a hit the cached rows are
+        copied into the slot device-side and the returned offset tells
+        the engine where its tail chunks start (0 on miss; the cap/
+        rounding policy is :func:`serving.prefix.usable_reuse`)."""
+        self._pads[slot] = 0
+        self._tokens[slot] = 0
+        self._cur[slot] = 0  # frontier: nothing written yet
+        if self.prefix_cache is None:
+            return 0
+        key, n_cached, payload = self.prefix_cache.lookup(prompt)
+        reuse = usable_reuse(n_cached, len(prompt), chunk)
+        if reuse <= 0 or payload is None:
+            self.prefix_cache.note_miss()
+            return 0
+        GLOBAL_COMPILE_CACHE.note(
+            "serve_prefix_put", (_tree_sig(payload), _tree_sig(self.cache)))
+        self.cache = self._guarded(_scatter_prefix_rows, self.cache,
+                                   payload, jnp.int32(slot))
+        self.prefix_cache.use(key, reuse)
+        self._cur[slot] = reuse  # frontier: tail chunks start here
+        return reuse
+
+    def prefill_chunk(self, slot: int, chunk, offset: int,
+                      n_valid: int, window: int | None = None) -> int:
+        """Consume one fixed-size chunk of a prompt into ``slot`` at
+        ``[offset, offset + C)``; ``n_valid`` = real (non-pad) tokens in
+        the chunk; ``window`` = the request's chunk-aligned total
+        prompt length (the chunk touches/attends only that many rows —
+        a short prompt's chunk never pays O(C·max_len) attention).
+        Returns the token sampled at the chunk's last real position —
+        the engine uses it only from the FINAL chunk."""
+        ids = jnp.asarray(np.asarray(chunk, np.int32)[None, :])
+        window = self.max_len if window is None \
+            else min(int(window), self.max_len)
+        # One compiled program per (chunk size, window) — window values
+        # are chunk multiples, so the program count is bounded by
+        # max_len/C; slot/offset/n_valid are traced. A NEW combination
+        # is a visible recompile event.
+        GLOBAL_COMPILE_CACHE.note(
+            "serve_prefill_chunk",
+            (_tree_sig((ids,)), _tree_sig(self.cache), window,
+             self.temperature, self.top_k, self.top_p))
+        key = self._rng if self.temperature <= 0.0 else \
+            jax.random.fold_in(self._rng, (1 << 20) + self._prefill_i)
+        self._prefill_i += 1
+        tok, self.cache = self._guarded(
+            L.prefill_chunk_into_slot, self.model, self.params, ids,
+            self.cache, jnp.int32(slot), jnp.int32(offset),
+            jnp.int32(n_valid), key, window=window,
+            temperature=self.temperature,
+            top_k=self.top_k, top_p=self.top_p)
+        # frontier: the next write (chunk or first decode token) lands
+        # past this chunk's rows
+        self._cur[slot] = offset + len(chunk)
+        return int(np.asarray(tok)[0])
+
+    def finish_prefill(self, slot: int, prompt, last_tok: int,
+                       aligned_len: int, commit: bool = True) -> int:
+        """Complete a chunked prefill: pin the slot's decode state at
+        the REAL prompt length and (when ``commit`` — the engine skips
+        one-chunk prompts and warm hits whose only new rows are a
+        distinct tail) copy the prompt's rows into the prefix cache
+        (``aligned_len`` = chunk-aligned written length — the engine's
+        chunk plan knows it; bounding the stored row count to chunk
+        multiples bounds the copy-program count). Returns the request's
+        first token."""
+        n = len(prompt)
+        self._tokens[slot] = int(last_tok)
+        self._cur[slot] = n
+        self._pads[slot] = 0
+        if commit and self.prefix_cache is not None:
+            try:
+                self._commit_prefix(slot, prompt, aligned_len)
+            except Exception as e:  # noqa: BLE001 — caching is an
+                if not self._warned_commit:  # optimization, never fatal
+                    self._warned_commit = True
+                    log.warning("prefix-cache commit failed (%s: %s); "
+                                "suppressing further warnings",
+                                type(e).__name__, e)
+        return int(last_tok)
+
+    def _commit_prefix(self, slot: int, prompt, aligned_len: int):
+        key = tuple(int(t) for t in prompt)
+        cache_obj = self.prefix_cache
+        if cache_obj is None or aligned_len < 1:
+            return
+        rows = min(int(aligned_len), self.max_len)
+        GLOBAL_COMPILE_CACHE.note(
+            "serve_prefix_gather", (rows, _tree_sig(self.cache)))
+        payload = _gather_slot_rows(self.cache, jnp.int32(slot), rows=rows)
+        nbytes = sum(x.size * x.dtype.itemsize
+                     for x in jax.tree_util.tree_leaves(payload)
+                     if getattr(x, "ndim", 0) == 4)
+        cache_obj.put(key, payload, nbytes)
+
+    def prefix_stats(self) -> dict | None:
+        return None if self.prefix_cache is None else \
+            self.prefix_cache.stats()
 
     def step(self, active_slots) -> list[int]:
         """Advance every slot one token at its own fill index; returns

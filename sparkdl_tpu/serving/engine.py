@@ -12,13 +12,24 @@ iteration:
 
 1. finished slots (EOS / max-tokens) are **retired** and their requests
    completed;
-2. free slots are **refilled** from the queue — the new prompt prefills
-   *into that slot* (one bucketed prefill program per prompt-length
-   bucket) while the other slots' in-flight state stays put;
-3. one **decode step** advances every busy slot one token at its own
+2. free slots are **admitted** from the queue and their prompts
+   consumed — stall-free by default (``SPARKDL_SERVE_STALL_FREE=1``):
+   at most ONE fixed-size chunk (``SPARKDL_SERVE_PREFILL_CHUNK``
+   tokens) of at most one PREFILLING slot runs per iteration,
+   interleaved with everyone else's decode, so a long prompt never
+   preempts the decode batch for a whole O(L²) prefill (the blocking
+   whole-prompt refill is the ``=0`` fallback); prompts that share a
+   cached prefix copy those K/V rows device-side and chunk-prefill only
+   the tail (``serving.prefix.PrefixCache``,
+   ``SPARKDL_SERVE_PREFIX_CACHE_MB``);
+3. one **decode step** advances every RUNNING slot one token at its own
    fill index — compiled once per (num_slots, max_len), never re-traced
-   by refills, so the batch never drains and aggregate tokens/s is
-   bounded by compute, not by the longest request in a batch.
+   by refills or mid-prefill neighbors, so the batch never drains and
+   aggregate tokens/s is bounded by compute, not by the longest request
+   in a batch. ``serve_decode_stall`` accounting (engine stats,
+   telemetry counter + histogram, and a flight-recorder span teed into
+   ``StageAccountant``) records exactly how much wall time RUNNING
+   slots spent not decoding while prefill work ran.
 
 Design split: this module is **jax-free** — the scheduler, queue, slot
 table, request state machine, streaming callbacks, and failure policy
@@ -67,6 +78,7 @@ __all__ = [
     "GenerationEngine", "Request", "StubBackend", "bucket_length",
     "ServingError", "RequestRejected", "QueueFullError",
     "RequestQuarantined", "ServingStallError", "EngineStopped",
+    "PREFILLING",
 ]
 
 log = logging.getLogger("sparkdl_tpu.serving")
@@ -77,20 +89,31 @@ QUEUE_CAP_ENV = "SPARKDL_SERVE_QUEUE_CAP"
 RETRIES_ENV = "SPARKDL_SERVE_RETRIES"
 STALL_ENV = "SPARKDL_SERVE_STALL_S"
 MIN_BUCKET_ENV = "SPARKDL_SERVE_MIN_BUCKET"
+CHUNK_ENV = "SPARKDL_SERVE_PREFILL_CHUNK"
+STALL_FREE_ENV = "SPARKDL_SERVE_STALL_FREE"
 
 _DEFAULT_SLOTS = 8
 _DEFAULT_MAX_LEN = 2048
 _DEFAULT_QUEUE_CAP = 128
 _DEFAULT_RETRIES = 1
 _DEFAULT_MIN_BUCKET = 16
+_DEFAULT_CHUNK = 32
 
 # Request-latency-shaped histogram bounds (seconds). The telemetry
 # default buckets top out at 10s (span-duration-shaped) — a long-tail
 # generation easily waits + decodes past that, and the quantile helper
 # clamps +Inf-bucket ranks to the last finite bound, which would
 # silently saturate the bench's p95/p99 at 10.0.
-_LATENCY_BUCKETS = (0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
-                    10.0, 30.0, 60.0, 180.0, 600.0)
+_LATENCY_BUCKETS = (0.005, 0.02, 0.05, 0.1, 0.15, 0.25, 0.35, 0.5,
+                    0.75, 1.0, 1.5, 2.5, 5.0, 10.0, 30.0, 60.0, 180.0,
+                    600.0)
+# Decode-stall-shaped bounds: one stall event is one prefill (chunk or
+# whole prompt) that ran while RUNNING slots waited — sub-ms on a stub,
+# tens of ms per chunk on a real model, whole-prompt seconds on the
+# blocking path. The histogram's job is exactly to show that shape
+# difference between SPARKDL_SERVE_STALL_FREE=1 and =0.
+_STALL_BUCKETS = (0.0005, 0.002, 0.005, 0.02, 0.05, 0.1, 0.25, 0.5,
+                  1.0, 2.5, 10.0)
 
 
 def _env_num(name: str, default, cast=int):
@@ -143,8 +166,11 @@ def bucket_length(prompt_len: int, min_bucket: int = _DEFAULT_MIN_BUCKET
 
 
 # Request lifecycle states (plain strings — they serialize into events
-# and stats as-is).
+# and stats as-is). PREFILLING is the stall-free scheduler's state: the
+# request owns a slot and its prompt is being consumed chunk by chunk,
+# interleaved with the other slots' decode steps.
 QUEUED = "queued"
+PREFILLING = "prefilling"
 RUNNING = "running"
 DONE = "done"
 FAILED = "failed"
@@ -177,6 +203,12 @@ class Request:
         self.t_admit: float | None = None
         self.t_first_token: float | None = None
         self.t_done: float | None = None
+        # chunked (stall-free) prefill plan — filled at admission
+        self.chunk_plan: list | None = None  # [(tokens[C], n_valid), ...]
+        self.chunk_base = 0       # cache offset of chunk 0 (prefix reuse)
+        self.next_chunk = 0       # committed chunks resume from here
+        self.prefill_reused = 0   # prefix-cache tokens skipped
+        self.prefill_spent_s = 0.0
         self._done = threading.Event()
 
     # -- caller-side API --------------------------------------------------
@@ -213,29 +245,86 @@ class StubBackend:
     Token stream per request: ``key = sum(prompt) + len(prompt)``,
     ``tok_n = (seed + key·31 + n·7) % vocab_size`` — deterministic in
     the prompt alone, so two runs of the same workload emit identical
-    streams regardless of slot placement. ``step_s``/``prefill_s`` add
-    synthetic per-call latency (bench shaping)."""
+    streams regardless of slot placement, chunking, or prefix reuse
+    (the stall-free and blocking paths are trivially token-identical
+    here by construction — the CPU llama tests carry the real
+    equivalence proof). ``step_s``/``prefill_s``/``prefill_tok_s`` add
+    synthetic per-call latency (bench shaping): a blocking prefill
+    costs ``prefill_s + prefill_tok_s·bucket``, one chunk costs
+    ``prefill_s + prefill_tok_s·C`` — per-token cost models the real
+    O(tokens) device work, so prefix-cache reuse (fewer tail tokens)
+    and bucket padding (blocking pads to the power-of-two bucket)
+    show up in stub wall time exactly as they do on hardware.
+
+    Mirrors the full chunked protocol (``begin_prefill`` /
+    ``prefill_chunk`` / ``finish_prefill``) and the shared-prefix LRU
+    (:class:`serving.prefix.PrefixCache` with synthetic
+    ``prefix_bytes_per_token`` entry sizes) jax-free, so the scheduler
+    logic — including hit/evict accounting — is tier-1-testable."""
 
     def __init__(self, num_slots: int, max_len: int, *,
                  vocab_size: int = 32000, step_s: float = 0.0,
-                 prefill_s: float = 0.0, seed: int = 0):
+                 prefill_s: float = 0.0, prefill_tok_s: float = 0.0,
+                 seed: int = 0, prefix_cache_bytes: int | None = None,
+                 prefix_bytes_per_token: int = 1024):
+        from .prefix import PrefixCache, prefix_cache_budget_bytes
         self.num_slots = num_slots
         self.max_len = max_len
         self.vocab_size = vocab_size
         self.step_s = step_s
         self.prefill_s = prefill_s
+        self.prefill_tok_s = prefill_tok_s
         self.seed = seed
+        self.prefix_bytes_per_token = int(prefix_bytes_per_token)
         self._state = [(0, 0)] * num_slots  # (prompt_key, n_emitted)
+        budget = prefix_cache_budget_bytes() if prefix_cache_bytes is None \
+            else max(0, int(prefix_cache_bytes))
+        self.prefix_cache = PrefixCache(budget) if budget > 0 else None
 
     def _tok(self, key: int, n: int) -> int:
         return (self.seed + key * 31 + n * 7) % self.vocab_size
 
     def prefill(self, slot: int, prompt, bucket: int) -> int:
-        if self.prefill_s:
-            time.sleep(self.prefill_s)
+        if self.prefill_s or self.prefill_tok_s:
+            time.sleep(self.prefill_s + self.prefill_tok_s * bucket)
         key = sum(prompt) + len(prompt)
         self._state[slot] = (key, 1)
         return self._tok(key, 0)
+
+    # -- chunked (stall-free) protocol, mirroring LlamaSlotBackend --------
+    def begin_prefill(self, slot: int, prompt, chunk: int) -> int:
+        from .prefix import usable_reuse
+        self._state[slot] = (0, 0)
+        if self.prefix_cache is None:
+            return 0
+        key, n_cached, _payload = self.prefix_cache.lookup(prompt)
+        reuse = usable_reuse(n_cached, len(prompt), chunk)
+        if reuse <= 0:
+            self.prefix_cache.note_miss()
+            return 0
+        self.prefix_cache.use(key, reuse)
+        return reuse
+
+    def prefill_chunk(self, slot: int, chunk_tokens, offset: int,
+                      n_valid: int, window: int | None = None) -> int:
+        if self.prefill_s or self.prefill_tok_s:
+            time.sleep(self.prefill_s
+                       + self.prefill_tok_s * len(chunk_tokens))
+        return 0  # the engine reads the first token from finish_prefill
+
+    def finish_prefill(self, slot: int, prompt, last_tok: int,
+                       aligned_len: int, commit: bool = True) -> int:
+        key = sum(prompt) + len(prompt)
+        self._state[slot] = (key, 1)
+        if commit and self.prefix_cache is not None:
+            self.prefix_cache.put(
+                tuple(prompt), tuple(prompt),
+                len(prompt) * self.prefix_bytes_per_token)
+        return self._tok(key, 0)
+
+    def prefix_stats(self) -> dict | None:
+        return None if self.prefix_cache is None else \
+            self.prefix_cache.stats()
 
     def step(self, active_slots) -> list[int]:
         if self.step_s:
@@ -261,9 +350,28 @@ class GenerationEngine:
                  queue_capacity: int | None = None,
                  retries: int | None = None,
                  stall_s: float | None = None,
-                 min_bucket: int | None = None):
+                 min_bucket: int | None = None,
+                 stall_free: bool | None = None,
+                 prefill_chunk: int | None = None):
         self.backend = backend
         self.eos_id = eos_id
+        # Stall-free scheduling (SPARKDL_SERVE_STALL_FREE, default on):
+        # prompts are consumed in fixed-size chunks interleaved with the
+        # decode step instead of blocking it for a whole O(L^2) prefill.
+        # Requires the backend to speak the chunked protocol; otherwise
+        # fall back to the blocking path with a warning.
+        want_sf = (os.environ.get(STALL_FREE_ENV, "1").lower()
+                   not in ("0", "false")) if stall_free is None \
+            else bool(stall_free)
+        self.stall_free = want_sf and hasattr(backend, "prefill_chunk")
+        if want_sf and not self.stall_free:
+            log.warning("backend %s lacks the chunked prefill protocol; "
+                        "falling back to blocking refills",
+                        type(backend).__name__)
+        self.prefill_chunk = max(1, prefill_chunk
+                                 if prefill_chunk is not None
+                                 else _env_num(CHUNK_ENV, _DEFAULT_CHUNK))
+        self.prefill_chunk = min(self.prefill_chunk, backend.max_len)
         # Floor 1: capacity 0 would make every blocking submit() spin
         # forever on `len(queue) >= 0` with no exit condition.
         self.queue_capacity = max(1, queue_capacity
@@ -290,7 +398,8 @@ class GenerationEngine:
             "quarantined": 0, "failed": 0, "tokens_out": 0, "steps": 0,
             "prefills": 0, "prefill_retries": 0, "step_retries": 0,
             "peak_queue_depth": 0, "peak_slots_busy": 0,
-            "callback_errors": 0,
+            "callback_errors": 0, "prefill_chunks": 0,
+            "decode_stall_s": 0.0, "decode_stall_events": 0,
         }
 
     # -- construction -----------------------------------------------------
@@ -298,21 +407,27 @@ class GenerationEngine:
     def from_model(cls, model, variables, *, num_slots: int | None = None,
                    max_len: int | None = None, temperature: float = 0.0,
                    top_k: int = 0, top_p: float = 1.0, seed: int = 0,
-                   eos_id: int | None = None, **kw) -> "GenerationEngine":
+                   eos_id: int | None = None,
+                   prefix_cache_mb: float | None = None,
+                   **kw) -> "GenerationEngine":
         """Build an engine over :class:`serving.backend.LlamaSlotBackend`
-        (the jax import happens here, not at module import)."""
+        (the jax import happens here, not at module import).
+        ``prefix_cache_mb`` overrides ``SPARKDL_SERVE_PREFIX_CACHE_MB``
+        (0 disables shared-prefix KV reuse)."""
         from .backend import LlamaSlotBackend  # deferred: jax
         num_slots = num_slots if num_slots is not None \
             else _env_num(SLOTS_ENV, _DEFAULT_SLOTS)
         max_len = max_len if max_len is not None \
             else _env_num(MAX_LEN_ENV, _DEFAULT_MAX_LEN)
-        backend = LlamaSlotBackend(model, variables, num_slots, max_len,
-                                   temperature=temperature, top_k=top_k,
-                                   top_p=top_p, seed=seed)
+        backend = LlamaSlotBackend(
+            model, variables, num_slots, max_len, temperature=temperature,
+            top_k=top_k, top_p=top_p, seed=seed,
+            prefix_cache_bytes=None if prefix_cache_mb is None
+            else int(prefix_cache_mb * 2 ** 20))
         return cls(backend, eos_id=eos_id, **kw)
 
     # -- telemetry helpers ------------------------------------------------
-    def _metric(self, kind: str, name: str, *args):
+    def _metric(self, kind: str, name: str, *args, buckets=None):
         if not telemetry.enabled():
             return
         reg = telemetry.registry()
@@ -321,7 +436,25 @@ class GenerationEngine:
         elif kind == "gauge":
             reg.gauge(name).set(*args)
         else:
-            reg.histogram(name, _LATENCY_BUCKETS).observe(*args)
+            reg.histogram(name, buckets or _LATENCY_BUCKETS).observe(*args)
+
+    def _note_stall(self, dt: float, n_running: int):
+        """Account one prefill-induced decode stall: a prefill (whole
+        prompt on the blocking path, one chunk on the stall-free path)
+        ran for ``dt`` wall seconds while ``n_running`` RUNNING slots
+        sat idle instead of decoding. The ``serve_decode_stall`` span
+        tees into ``StageAccountant``/``bottleneck_report`` like every
+        other stage, so the scheduler's before/after is provable from
+        the flight recorder, not just the bench."""
+        if n_running <= 0 or dt <= 0:
+            return
+        self.stats["decode_stall_s"] += dt
+        self.stats["decode_stall_events"] += 1
+        events.completed_span("serve_decode_stall", dt,
+                              slots_waiting=n_running)
+        self._metric("counter", "serving_decode_stall_s_total", dt)
+        self._metric("histogram", "serve_decode_stall_s", dt,
+                     buckets=_STALL_BUCKETS)
 
     # -- admission --------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 16, *,
@@ -348,12 +481,25 @@ class GenerationEngine:
             # reject at the door, with the offending id named
             bad = next(t for t in prompt if t < 0 or t >= vocab)
             self._reject(f"token id {bad} outside vocab [0, {vocab})")
-        bucket = bucket_length(len(prompt), self.min_bucket)
-        if bucket + max_new_tokens > self.backend.max_len:
-            self._reject(
-                f"bucketed prompt ({bucket}) + max_new_tokens "
-                f"({max_new_tokens}) exceeds max_len "
-                f"{self.backend.max_len}")
+        if self.stall_free:
+            # Chunked placement is zero-aligned: the prompt writes rows
+            # [0, ceil(L/C)*C) (pad tail included) and decode continues
+            # from L — both ends must fit the slot row.
+            c = self.prefill_chunk
+            bucket = -(-len(prompt) // c) * c
+            if max(bucket, len(prompt) + max_new_tokens) > \
+                    self.backend.max_len:
+                self._reject(
+                    f"chunk-aligned prompt ({bucket}) + max_new_tokens "
+                    f"({max_new_tokens}) exceeds max_len "
+                    f"{self.backend.max_len}")
+        else:
+            bucket = bucket_length(len(prompt), self.min_bucket)
+            if bucket + max_new_tokens > self.backend.max_len:
+                self._reject(
+                    f"bucketed prompt ({bucket}) + max_new_tokens "
+                    f"({max_new_tokens}) exceeds max_len "
+                    f"{self.backend.max_len}")
         deadline = None if timeout is None else time.time() + timeout
         with self._work:
             if self._stop_mode is not None or self._fatal is not None:
@@ -397,22 +543,31 @@ class GenerationEngine:
 
     # -- scheduling loop --------------------------------------------------
     def step(self) -> bool:
-        """One scheduler iteration: retire/refill free slots from the
-        queue, then advance every busy slot one token. Returns True when
-        any work happened (refill or decode); False when idle — the
-        inline-drive loop condition."""
+        """One scheduler iteration. Stall-free (default): admit queued
+        requests into free slots, advance AT MOST ONE chunk of at most
+        one PREFILLING slot, then advance every RUNNING slot one decode
+        step — a long prompt is consumed interleaved with everyone
+        else's decode instead of monopolizing the device. Blocking
+        fallback (``SPARKDL_SERVE_STALL_FREE=0``): retire/refill free
+        slots with whole-prompt prefills, then decode. Returns True when
+        any work happened; False when idle — the inline-drive loop
+        condition."""
         if self._fatal is not None:
             raise EngineStopped("engine died") from self._fatal
-        refilled = self._refill()
+        if self.stall_free:
+            worked = self._admit() > 0
+            worked = self._prefill_tick() or worked
+        else:
+            worked = self._refill() > 0
         with self._lock:
+            busy = sum(r is not None for r in self._slots)
             active = [(s, r) for s, r in enumerate(self._slots)
-                      if r is not None]
-        busy = len(active)
+                      if r is not None and r.state == RUNNING]
         if busy > self.stats["peak_slots_busy"]:
             self.stats["peak_slots_busy"] = busy
         self._metric("gauge", "serving_slots_busy", busy)
         if not active:
-            return refilled > 0
+            return worked
         toks = self._step_with_isolation()
         if toks is not None:
             self.stats["steps"] += 1
@@ -499,34 +654,208 @@ class GenerationEngine:
                     self._thread = None
 
     # -- refill -----------------------------------------------------------
+    def _pop_to_slot(self):
+        """Move the queue head into the lowest free slot (admission
+        bookkeeping shared by both scheduler modes); returns
+        ``(req, slot)`` or ``(None, None)`` when there is nothing to
+        do."""
+        with self._work:
+            free = [s for s, r in enumerate(self._slots) if r is None]
+            if not free or not self._queue:
+                return None, None
+            req = self._queue.popleft()
+            slot = min(free)  # deterministic: lowest free slot, FIFO
+            self._slots[slot] = req
+            depth = len(self._queue)
+            self._work.notify_all()  # queue space freed
+        req.t_admit = time.time()
+        req.slot = slot
+        self._metric("gauge", "serving_queue_depth", depth)
+        wait_s = req.t_admit - req.t_submit
+        events.completed_span("serve_queue", wait_s, request=req.id)
+        self._metric("histogram", "serving_queue_wait_s", wait_s)
+        return req, slot
+
     def _refill(self) -> int:
+        """Blocking-mode refill: every free slot prefills its whole
+        prompt inside this scheduler iteration (the pre-ISSUE-10
+        head-of-line stall the stall-free path removes)."""
         admitted = 0
         while True:
-            with self._work:
-                free = [s for s, r in enumerate(self._slots) if r is None]
-                if not free or not self._queue:
-                    break
-                req = self._queue.popleft()
-                slot = min(free)  # deterministic: lowest free slot, FIFO
-                self._slots[slot] = req
-                depth = len(self._queue)
-                self._work.notify_all()  # queue space freed
+            req, slot = self._pop_to_slot()
+            if req is None:
+                break
             admitted += 1
-            req.t_admit = time.time()
-            req.slot = slot
-            self._metric("gauge", "serving_queue_depth", depth)
-            wait_s = req.t_admit - req.t_submit
-            events.completed_span("serve_queue", wait_s, request=req.id)
-            self._metric("histogram", "serving_queue_wait_s", wait_s)
             if not self._prefill_with_retries(req, slot):
                 with self._work:
                     self._slots[slot] = None
                     self._work.notify_all()
+                # Same release as retirement/eviction/chunked
+                # quarantine: a release()-ful backend must never leak a
+                # slot's fill state on the blocking path either.
+                self._release_slot(slot)
         return admitted
+
+    # -- stall-free admission + chunked prefill ---------------------------
+    def _admit(self) -> int:
+        """Move queued requests into free slots as PREFILLING (prefix
+        seed + chunk plan; no prompt compute happens here — chunks run
+        one per iteration in :meth:`_prefill_tick`)."""
+        admitted = 0
+        while True:
+            req, slot = self._pop_to_slot()
+            if req is None:
+                break
+            admitted += 1
+            self._arm_chunked_prefill(req, slot)
+        return admitted
+
+    def _arm_chunked_prefill(self, req: Request, slot: int):
+        c = self.prefill_chunk
+        with self._lock:
+            n_running = sum(1 for r in self._slots
+                            if r is not None and r.state == RUNNING)
+        start = 0
+        t0 = time.perf_counter()
+        try:
+            # Under the same watchdog + stall ledger as every other
+            # device call: a prefix-cache hit scatters K/V rows
+            # device-side, which both stalls running decodes and can
+            # wedge exactly like a chunk.
+            start = int(self._timed(
+                lambda: self.backend.begin_prefill(slot, req.prompt, c),
+                "prefix_seed"))
+        except ServingStallError:
+            raise  # a wedged device is never a per-request fault
+        except Exception as e:  # noqa: BLE001 — reuse is an optimization
+            if getattr(e, "serving_fatal", False):
+                self._handle_fatal(e)
+                raise
+            events.event("serve_prefix_seed_failed", request=req.id,
+                         error=f"{type(e).__name__}: {e}"[:200])
+            start = 0
+        dt = time.perf_counter() - t0
+        self._note_stall(dt, n_running)
+        req.prefill_spent_s += dt
+        # Guard the contract (usable_reuse): a drifted backend must
+        # degrade to a cold prefill, never hand the chunker an empty or
+        # misaligned plan (a non-chunk-multiple start could make the
+        # final chunk's scatter clamp at max_len and slide back over
+        # committed rows).
+        if not 0 <= start < len(req.prompt) or start % c:
+            if start != 0:
+                log.warning("backend.begin_prefill returned offset %s "
+                            "for a %s-token prompt (chunk %s); ignoring "
+                            "prefix reuse", start, len(req.prompt), c)
+            start = 0
+        tail = req.prompt[start:]
+        plan = []
+        for i in range(0, len(tail), c):
+            part = list(tail[i:i + c])
+            nv = len(part)
+            if nv < c:  # final chunk right-pads; n_valid marks the reals
+                part = part + [0] * (c - nv)
+            plan.append((part, nv))
+        req.chunk_plan = plan
+        req.chunk_base = start
+        req.next_chunk = 0
+        req.prefill_reused = start
+        req.state = PREFILLING
+
+    def _prefill_tick(self) -> bool:
+        """Advance the OLDEST-admitted PREFILLING slot by exactly one
+        chunk (the per-iteration prefill token budget is the chunk
+        size): every other slot's decode step runs in the same
+        iteration, so a long prompt costs each running request one
+        chunk of extra latency per step, never a whole O(L²) prefill.
+        Chunk-aware retry: a failed chunk stays current (the cache
+        holds every committed chunk) and is re-attempted next tick;
+        past the retry budget the REQUEST is quarantined and its slot
+        freed — the gang keeps serving."""
+        with self._lock:
+            prefilling = [r for r in self._slots
+                          if r is not None and r.state == PREFILLING]
+            if not prefilling:
+                return False
+            req = min(prefilling, key=lambda r: (r.t_admit or 0.0, r.id))
+            n_running = sum(1 for r in self._slots
+                            if r is not None and r.state == RUNNING)
+        c = self.prefill_chunk
+        chunk, n_valid = req.chunk_plan[req.next_chunk]
+        offset = req.chunk_base + req.next_chunk * c
+        final = req.next_chunk == len(req.chunk_plan) - 1
+        window = req.chunk_base + len(req.chunk_plan) * c
+        t0 = time.perf_counter()
+        try:
+            tok = self._timed(
+                lambda: self.backend.prefill_chunk(req.slot, chunk,
+                                                   offset, n_valid,
+                                                   window),
+                "prefill_chunk")
+            if final:
+                aligned = req.chunk_base + len(req.chunk_plan) * c
+                # Commit policy: caching a one-chunk prompt can never
+                # save a chunk on reuse, and a prompt the cache already
+                # mostly served (a warm hit's distinct tail) adds no
+                # reusable head — skip the commit copy for both.
+                commit = aligned > c and req.prefill_reused * 2 < aligned
+                tok = self._timed(
+                    lambda: self.backend.finish_prefill(
+                        req.slot, req.prompt, tok, aligned,
+                        commit=commit),
+                    "finish_prefill")
+        except ServingStallError:
+            raise  # a wedged device is never a per-request fault
+        except Exception as e:  # noqa: BLE001 — per-request isolation
+            if getattr(e, "serving_fatal", False):
+                self._handle_fatal(e)
+                raise
+            self._note_stall(time.perf_counter() - t0, n_running)
+            req.failures += 1
+            if req.failures > self.retries:
+                with self._work:
+                    if req.slot is not None and \
+                            self._slots[req.slot] is req:
+                        self._slots[req.slot] = None
+                    self._work.notify_all()
+                self._release_slot(req.slot)
+                self._quarantine(req, e)
+            else:
+                self.stats["prefill_retries"] += 1
+                events.event("serve_prefill_chunk_retry", request=req.id,
+                             chunk=req.next_chunk, offset=offset,
+                             attempt=req.failures,
+                             error=f"{type(e).__name__}: {e}"[:200])
+            return True
+        dt = time.perf_counter() - t0
+        self._note_stall(dt, n_running)
+        req.prefill_spent_s += dt
+        req.next_chunk += 1
+        self.stats["prefill_chunks"] += 1
+        if final:
+            self.stats["prefills"] += 1
+            if req.state == FAILED:
+                # The engine failed over (stop(drain=False) / fatal)
+                # while the chunk was in flight: the request was already
+                # reported failed — never resurrect it to RUNNING or
+                # stream a token after the failure.
+                return True
+            req.state = RUNNING
+            req.t_decode_start = time.time()
+            events.completed_span(
+                "serve_prefill", req.prefill_spent_s, request=req.id,
+                slot=req.slot, bucket=req.bucket, rows=1,
+                chunks=len(req.chunk_plan), reused=req.prefill_reused)
+            self._deliver(req, int(tok))
+        return True
 
     def _prefill_with_retries(self, req: Request, slot: int) -> bool:
         last: BaseException | None = None
         for attempt in range(self.retries + 1):
+            with self._lock:
+                n_running = sum(1 for r in self._slots
+                                if r is not None and r.state == RUNNING)
+            t0 = time.perf_counter()
             try:
                 with events.span("serve_prefill", request=req.id, slot=slot,
                                  bucket=req.bucket, rows=1):
@@ -534,6 +863,10 @@ class GenerationEngine:
                         lambda: self.backend.prefill(slot, req.prompt,
                                                      req.bucket),
                         "prefill")
+                # The head-of-line stall this whole prefill inflicted on
+                # every already-RUNNING slot (the blocking-path number
+                # the stall-free scheduler is measured against).
+                self._note_stall(time.perf_counter() - t0, n_running)
                 self.stats["prefills"] += 1
                 if req.state == FAILED:
                     # The engine failed over (stop(drain=False) / fatal)
@@ -555,6 +888,7 @@ class GenerationEngine:
                     # innocent requests one by one.
                     self._handle_fatal(e)
                     raise
+                self._note_stall(time.perf_counter() - t0, n_running)
                 last = e
                 req.failures += 1
                 if attempt < self.retries:
@@ -738,9 +1072,17 @@ class GenerationEngine:
     # -- introspection ----------------------------------------------------
     def snapshot(self) -> dict:
         with self._lock:
-            return {
+            snap = {
                 "queue_depth": len(self._queue),
                 "slots_busy": sum(r is not None for r in self._slots),
                 "num_slots": len(self._slots),
+                "stall_free": self.stall_free,
+                "prefill_chunk": self.prefill_chunk,
                 **dict(self.stats),
             }
+        ps = getattr(self.backend, "prefix_stats", None)
+        if callable(ps):
+            st = ps()
+            if st:
+                snap["prefix_cache"] = st
+        return snap

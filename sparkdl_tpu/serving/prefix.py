@@ -1,0 +1,181 @@
+"""Shared-prefix KV reuse — the jax-free LRU bookkeeping (ISSUE 10).
+
+A serving fleet sees the same prompt *heads* over and over (system
+prompts, few-shot preambles, retry storms of one request). Prefilling
+those tokens again is pure waste: the K/V a transformer writes for
+token ``i`` depends only on tokens ``[0, i]``, so two prompts sharing a
+token prefix share — bit for bit — the prefix's cache rows. This module
+is the bookkeeping half of that reuse: an LRU of
+``token-tuple → opaque payload`` under a byte budget, with hit / miss /
+eviction counters. The payloads are opaque on purpose: the llama
+backend stores device-resident K/V row pytrees (copied slot→entry and
+entry→slot device-side, never through the host), while the jax-free
+``StubBackend`` stores token tuples with synthetic byte sizes — the
+scheduler logic around the cache is tier-1-testable without a device.
+
+Hash scope: the key is the **exact token id tuple** of a completed
+prefill's prompt (model- and layout-independent ids, not text — two
+tokenizations that differ in ids never collide; the dict hashes the
+tuple, token-by-token comparison makes collisions impossible).
+``lookup`` returns the entry sharing the longest COMMON token prefix
+with the new prompt — a stored prompt and a new one that diverge after
+a shared head still reuse the head (the backend overwrites everything
+past the reuse point before attention can read it).
+Invalidation is purely budget-driven (LRU under
+``SPARKDL_SERVE_PREFIX_CACHE_MB``): entries are immutable snapshots of
+prompt-derived K/V, so they can never go stale — only cold. A backend
+that swaps weights must ``clear()`` (new params ⇒ new K/V).
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+
+__all__ = ["PrefixCache", "PREFIX_CACHE_MB_ENV", "DEFAULT_PREFIX_CACHE_MB",
+           "prefix_cache_budget_bytes", "usable_reuse"]
+
+PREFIX_CACHE_MB_ENV = "SPARKDL_SERVE_PREFIX_CACHE_MB"
+DEFAULT_PREFIX_CACHE_MB = 64.0
+
+
+def prefix_cache_budget_bytes() -> int:
+    """The env-configured budget in bytes (``0`` disables the cache —
+    backends then skip the commit copies entirely)."""
+    try:
+        mb = float(os.environ.get(PREFIX_CACHE_MB_ENV,
+                                  DEFAULT_PREFIX_CACHE_MB))
+    except ValueError:
+        mb = DEFAULT_PREFIX_CACHE_MB
+    return max(0, int(mb * 2 ** 20))
+
+
+def usable_reuse(n_shared: int, prompt_len: int, chunk: int) -> int:
+    """THE reuse-rounding policy, shared by every backend (a drifted
+    copy would desync the stub from the real backend and could hand the
+    engine an empty chunk plan): usable reuse is capped at
+    ``prompt_len - 1`` (the last token must run through the model to
+    produce the first logits) and rounded DOWN to a ``chunk`` multiple
+    (tail chunks then end exactly at the admission-checked
+    ``ceil(L/chunk)*chunk`` row, so the final chunk's scatter can never
+    clamp against ``max_len`` and slide back over committed rows — and
+    committed payload row counts stay chunk multiples, bounding the
+    copy-program count)."""
+    chunk = max(1, int(chunk))
+    return (min(int(n_shared), int(prompt_len) - 1) // chunk) * chunk
+
+
+class PrefixCache:
+    """LRU of ``(token tuple → payload)`` under a byte budget.
+
+    Thread-safe (the engine thread commits while ``submit`` callers may
+    snapshot stats). An entry counts ``nbytes`` against the budget as
+    reported by the committer; inserting past the budget evicts
+    least-recently-used entries first. An entry larger than the whole
+    budget is refused (counted as an ``oversize`` non-insert, never a
+    crash).
+    """
+
+    def __init__(self, budget_bytes: int):
+        self.budget_bytes = max(0, int(budget_bytes))
+        self._entries: collections.OrderedDict = collections.OrderedDict()
+        # key -> (payload, nbytes, n_tokens)
+        self._lock = threading.Lock()
+        self.bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.oversize = 0
+        self.reused_tokens = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def lookup(self, prompt) -> tuple[object, int, object]:
+        """Entry with the longest COMMON token prefix with ``prompt``
+        (the stored prompt need not be a prefix of the new one — two
+        requests sharing a system-prompt head hit each other even
+        though their tails diverge; the backend's scatter + the tail
+        chunks' write-frontier overwrite make any rows past the shared
+        head harmless). Returns ``(key, n_shared, payload)``;
+        ``(None, 0, None)`` when nothing shares even one token. Pure —
+        counters and LRU order move only when the caller commits to
+        using (or skipping) the match via :meth:`use` /
+        :meth:`note_miss`, because a match whose usable chunk-aligned
+        reuse (:func:`usable_reuse`) rounds to zero is not a hit.
+
+        Cost: O(entries x shared-head) token comparisons under the
+        lock. Entry count is budget-bounded and device payloads are
+        MB-scale (a single request's K/V rows), so a real cache holds
+        tens of entries, not thousands — revisit with a radix/trie
+        index if entries ever become cheap."""
+        prompt = tuple(prompt)
+        best_key, best_shared = None, 0
+        with self._lock:
+            for key in self._entries:
+                shared = 0
+                for a, b in zip(key, prompt):
+                    if a != b:
+                        break
+                    shared += 1
+                if shared > best_shared:
+                    best_key, best_shared = key, shared
+            if best_key is None:
+                return None, 0, None
+            payload, _, _ = self._entries[best_key]
+            return best_key, best_shared, payload
+
+    def use(self, key, reused_tokens: int):
+        """Record one actual reuse of ``key`` (LRU touch + hit +
+        reused-token counters)."""
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+            self.hits += 1
+            self.reused_tokens += int(reused_tokens)
+
+    def note_miss(self):
+        with self._lock:
+            self.misses += 1
+
+    def put(self, prompt, payload, nbytes: int) -> bool:
+        """Insert (or LRU-refresh) one completed prefill's rows. Returns
+        True when the entry is resident after the call."""
+        key = tuple(prompt)
+        nbytes = int(nbytes)
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)  # refresh; keep the
+                return True                     # existing payload
+            if nbytes > self.budget_bytes:
+                self.oversize += 1
+                return False
+            while self.bytes + nbytes > self.budget_bytes and self._entries:
+                _, (_, old_bytes, _) = self._entries.popitem(last=False)
+                self.bytes -= old_bytes
+                self.evictions += 1
+            self._entries[key] = (payload, nbytes, len(key))
+            self.bytes += nbytes
+            return True
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+            self.bytes = 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": self.bytes,
+                "budget_bytes": self.budget_bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "oversize": self.oversize,
+                "reused_tokens": self.reused_tokens,
+                "hit_rate": round(self.hits / (self.hits + self.misses), 4)
+                if (self.hits + self.misses) else None,
+            }

@@ -38,18 +38,18 @@ def test_preprocess_functions():
 
 
 def test_resnet50_shapes_and_feature_dim():
+    # eval_shape end to end (the ISSUE 8/10 headroom pattern): shapes
+    # and feature dims need no parameter compute and no XLA compile —
+    # this was ~16s of real 224x224 ResNet50 forwards for a shape
+    # assertion. Real-forward numerics for the image models are pinned
+    # by the DeepImageFeaturizer equivalence test (ResNet18).
     m = get_model("ResNet50")
-    variables = m.init_params(seed=0)
-    feat_fn = jax.jit(m.apply_fn(features_only=True))
-    logit_fn = jax.jit(m.apply_fn(features_only=False))
-    x = np.random.default_rng(0).uniform(0, 255, (2, 224, 224, 3)).astype(np.float32)
-    feats = feat_fn(variables, x)
-    logits = logit_fn(variables, x)
+    variables = jax.eval_shape(lambda: m.init_params(seed=0))
+    x = jax.ShapeDtypeStruct((2, 224, 224, 3), np.float32)
+    feats = jax.eval_shape(m.apply_fn(features_only=True), variables, x)
+    logits = jax.eval_shape(m.apply_fn(features_only=False), variables, x)
     assert feats.shape == (2, 2048)
     assert logits.shape == (2, 1000)
-    # deterministic across calls
-    np.testing.assert_array_equal(np.asarray(feats),
-                                  np.asarray(feat_fn(variables, x)))
 
 
 @pytest.mark.slow
@@ -84,13 +84,18 @@ def test_param_counts_sane():
 
 
 def test_bf16_compute_fp32_params():
+    # dtype policy is a trace-level property — eval_shape carries dtypes
+    # without a ~6s real 224x224 forward (ISSUE 10 headroom satellite);
+    # bf16 NUMERICS are pinned by the featurizer bfloat16-close-to-f32
+    # test in test_transformers.
     m = get_model("ResNet18")
-    variables = m.init_params(seed=0, dtype=jnp.bfloat16)
+    variables = jax.eval_shape(
+        lambda: m.init_params(seed=0, dtype=jnp.bfloat16))
     p0 = jax.tree_util.tree_leaves(variables["params"])[0]
     assert p0.dtype == jnp.float32  # params stay fp32
-    fn = jax.jit(m.apply_fn(dtype=jnp.bfloat16, features_only=True))
-    x = np.zeros((1, 224, 224, 3), np.float32)
-    out = fn(variables, x)
+    x = jax.ShapeDtypeStruct((1, 224, 224, 3), np.float32)
+    out = jax.eval_shape(m.apply_fn(dtype=jnp.bfloat16,
+                                    features_only=True), variables, x)
     assert out.dtype == jnp.float32  # features cast back at the boundary
     assert out.shape == (1, 512)
 
