@@ -1140,6 +1140,19 @@ def _serve_headline(serve: dict) -> dict:
               "decode_stall_ratio"):
         if serve.get(k) is not None:
             out[f"serve_{k}"] = serve[k]
+    # ISSUE 11: the paged-KV high-churn evidence (jax-free stub leg,
+    # rides both healthy and backend_unavailable records) — pool
+    # utilization, shared-block fraction, admission-wait stats and the
+    # paged-vs-per-slot speedup at fixed pool bytes.
+    churn = serve.get("churn") or {}
+    for src, dst in (("paged_speedup", "serve_paged_speedup"),
+                     ("kv_pool_utilization", "serve_kv_pool_utilization"),
+                     ("blocks_shared_frac", "serve_blocks_shared_frac"),
+                     ("admission_block_waits",
+                      "serve_admission_block_waits"),
+                     ("preemptions", "serve_preemptions")):
+        if churn.get(src) is not None:
+            out[dst] = churn[src]
     return out
 
 

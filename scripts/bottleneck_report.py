@@ -77,6 +77,12 @@ def main(argv=None) -> int:
                   f"max_concurrency {st['max_concurrency']}")
         for name, n in sorted((agg.get("events") or {}).items()):
             print(f"  event {name}: {n}")
+        for name, g in sorted((agg.get("gauges") or {}).items()):
+            # Pool gauges make an HBM-bound engine attributable: a
+            # serving_kv_blocks_free floor near 0 with admission waits
+            # in the engine stats IS the bottleneck, no span needed.
+            print(f"  gauge {name}: {g.get('value', 0):g} "
+                  f"(high-water {g.get('max', 0):g})")
         for name, h in sorted((agg.get("histograms") or {}).items()):
             # One derivation for everyone: telemetry.histogram_quantile
             # is the same helper the serving bench uses, so a latency

@@ -180,11 +180,30 @@ def run_engine_leg(make_engine, workload, concurrency: int,
         "decode_stall_events": snap["decode_stall_events"],
         "prefill_chunks": snap["prefill_chunks"],
     }
+    if snap.get("paged"):
+        # ISSUE 11 pool evidence per leg: utilization/share from the
+        # allocator, shared-block high-water from the telemetry gauge
+        # (end-of-run shares drop to trie-only refs, so the peak is the
+        # concurrency observable), admission-wait stats from the engine.
+        shared_hw = reg.gauge("serving_kv_blocks_shared").snapshot()["max"]
+        pool = snap.get("kv_pool") or {}
+        rec["kv_pool"] = pool
+        rec["kv_pool_utilization"] = pool.get("peak_utilization")
+        rec["blocks_shared_peak"] = shared_hw
+        rec["blocks_shared_frac"] = round(
+            shared_hw / pool["blocks_total"], 4) \
+            if pool.get("blocks_total") else None
+        rec["admission_block_waits"] = snap["admission_block_waits"]
+        rec["block_stall_events"] = snap["block_stall_events"]
+        rec["preemptions"] = snap["preemptions"]
     if snap.get("prefix_cache"):
+        # key set differs by backend: the byte-payload LRU reports
+        # entries/bytes, the paged radix trie blocks/block_size
         ps = snap["prefix_cache"]
         rec["prefix_cache"] = {k: ps[k] for k in (
             "hits", "misses", "hit_rate", "reused_tokens", "entries",
-            "evictions", "bytes")}
+            "evictions", "bytes", "blocks", "inserted_blocks")
+            if k in ps}
     if errors:
         rec["errors"] = errors[:5]
     return rec
@@ -457,6 +476,100 @@ def _run_stub(n_requests: int, num_slots: int, max_len: int,
     return rec
 
 
+# ---------------------------------------------------------------------------
+# high-churn paged-vs-per-slot leg (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+_CHURN_PREAMBLE = 32   # shared head on every churn prompt (radix target)
+_CHURN_BODY = (8, 12, 16, 24)   # short distinct bodies
+_CHURN_OUT = (4, 6, 8)          # SHORT outputs: slot churn is the load
+_CHURN_BLOCK = 16
+
+
+def make_churn_workload(n: int, vocab: int = 32000, seed: int = 3):
+    """Short-output many-request chat mix: every prompt opens with the
+    same 32-token preamble, bodies are short and distinct, outputs 4-8
+    tokens — the request-turnover shape where admission pacing and the
+    per-slot ``max_len`` reservation (NOT decode compute) bound
+    throughput."""
+    rng = np.random.RandomState(seed)
+    preamble = rng.randint(0, vocab, _CHURN_PREAMBLE).tolist()
+    out = []
+    for _ in range(n):
+        body = rng.randint(0, vocab,
+                           int(rng.choice(_CHURN_BODY))).tolist()
+        out.append((preamble + body,
+                    int(rng.choice(_CHURN_OUT))))
+    return out
+
+
+def run_paged_churn_comparison(n_requests: int = 192,
+                               step_s: float = 0.0015,
+                               prefill_tok_s: float = 1e-4) -> dict:
+    """ISSUE 11 acceptance leg, jax-free: the SAME KV byte pool serves
+    8 per-slot rows (PR 9 engine — ``8 × max_len`` positions reserved
+    up front) vs a paged engine with 32 slots over a block pool of
+    identical size. Short outputs churn the slot table; the per-slot
+    engine is bounded by 8 concurrent requests while the paged engine
+    is bounded by what the pool actually holds — effective concurrency,
+    tokens/s, ``kv_pool_utilization`` and ``blocks_shared_frac`` (the
+    shared preamble resident as ONE physical block set) are the record.
+    The multi-chunk prefill budget (8 chunks/iteration) is what lets
+    admission keep up with 32-slot churn."""
+    from sparkdl_tpu.serving import GenerationEngine, StubBackend
+
+    slots_legacy, max_len = 8, 256
+    pool_positions = slots_legacy * max_len          # FIXED byte pool
+    pool_blocks = pool_positions // _CHURN_BLOCK + 1  # + trash block
+    slots_paged = 32
+    workload = make_churn_workload(n_requests)
+    chunk = _CHURN_BLOCK
+
+    def legacy_engine():
+        return GenerationEngine(
+            StubBackend(slots_legacy, max_len, step_s=step_s,
+                        prefill_tok_s=prefill_tok_s),
+            queue_capacity=max(64, n_requests), prefill_chunk=chunk)
+
+    def paged_engine():
+        return GenerationEngine(
+            StubBackend(slots_paged, max_len, step_s=step_s,
+                        prefill_tok_s=prefill_tok_s,
+                        block_size=_CHURN_BLOCK, pool_blocks=pool_blocks),
+            queue_capacity=max(64, n_requests), prefill_chunk=chunk,
+            # 32-slot churn needs ~slots/median-out ≈ 5 refills per
+            # iteration; 8 chunks covers that with radix hits (1-2
+            # tail chunks per request) — the one-chunk PR 9 budget is
+            # exactly what capped the old engine at ~1 refill/iteration
+            prefill_budget=8 * chunk)
+
+    legs = {}
+    for name, make in (("per_slot", legacy_engine), ("paged",
+                                                     paged_engine)):
+        legs[name] = run_engine_leg(make, workload, concurrency=32)
+    paged = legs["paged"]
+    rec = {
+        "mode": "stub_churn",
+        "block_size": _CHURN_BLOCK,
+        "pool_positions": pool_positions,
+        "slots_per_slot": slots_legacy,
+        "slots_paged": slots_paged,
+        "requests": n_requests,
+        "per_slot": legs["per_slot"],
+        "paged": paged,
+        # the ISSUE 11 acceptance observables, hoisted to the top level
+        "kv_pool_utilization": paged.get("kv_pool_utilization"),
+        "blocks_shared_frac": paged.get("blocks_shared_frac"),
+        "blocks_shared_peak": paged.get("blocks_shared_peak"),
+        "admission_block_waits": paged.get("admission_block_waits", 0),
+        "preemptions": paged.get("preemptions", 0),
+    }
+    if legs["per_slot"].get("tokens_s") and legs["paged"].get("tokens_s"):
+        rec["paged_speedup"] = round(
+            legs["paged"]["tokens_s"] / legs["per_slot"]["tokens_s"], 2)
+    return rec
+
+
 def run_stub_scheduler_comparison(n_requests: int = 96,
                                   num_slots: int = 8,
                                   step_s: float = 0.002,
@@ -485,8 +598,19 @@ def run(mode: str = "llama", rows: int | None = None) -> dict:
         step_s = float(os.environ.get("BENCH_SERVE_STUB_STEP_S", "0.002"))
         tok_s = float(os.environ.get("BENCH_SERVE_STUB_PREFILL_TOK_S",
                                      "2e-4"))
-        return _run_stub(n, slots, max_len, conc, step_s, tok_s)
-    return _run_llama(n, slots, max_len, conc)
+        rec = _run_stub(n, slots, max_len, conc, step_s, tok_s)
+    else:
+        rec = _run_llama(n, slots, max_len, conc)
+    # ISSUE 11 high-churn paged-vs-per-slot leg: a memory/scheduling
+    # property, measured jax-free on the stub (seconds of wall) so it
+    # rides BOTH the healthy llama record and the outage stub record.
+    if not os.environ.get("BENCH_SKIP_CHURN"):
+        try:
+            rec["churn"] = run_paged_churn_comparison(
+                n_requests=min(192, max(64, n)))
+        except Exception as e:  # noqa: BLE001 — the main legs stand
+            rec["churn_error"] = f"{type(e).__name__}: {e}"[:300]
+    return rec
 
 
 def main(argv=None) -> int:

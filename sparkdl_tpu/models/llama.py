@@ -838,6 +838,247 @@ def slot_decode_step(model, params, cache, tokens, slot_cur, pad_lens, rng,
 
 
 # ---------------------------------------------------------------------------
+# Paged slot primitives (block-table serving — ISSUE 11)
+# ---------------------------------------------------------------------------
+# The three slot primitives above address a PRIVATE [num_slots, ...,
+# max_len, ...] cache row per slot: HBM is reserved at num_slots x
+# max_len whatever requests actually use. The paged variants below
+# address ONE shared pool of [pool_blocks, Hkv, block_size, hd] K/V
+# blocks per layer through a per-slot block TABLE ([max_blocks] int32,
+# traced): logical cache position p of a slot lives at pool position
+# (table[p // block_size], p % block_size). Attention reads a
+# block-gathered dense view (the portable reference layout — a TPU
+# paged-attention kernel would fuse the gather), writes scatter ONLY
+# the newly produced positions back through the table, so a shared
+# prefix block is written once and read by every slot whose table names
+# it. Program signatures depend on (num_slots, max_blocks, pool_blocks)
+# and the static chunk/window sizes only — tables, slots, offsets and
+# fill indices are traced, so refills, grafts and block allocation
+# never re-trace (the same no-re-trace property the per-slot
+# primitives pin).
+
+
+def init_paged_pool(model: LlamaModel, pool_blocks: int, block_size: int):
+    """Zeroed shared K/V pool: per layer ``[pool_blocks, kv_heads,
+    block_size, head_dim]`` — structurally a ``init_cache`` with
+    batch=pool_blocks and max_len=block_size, which is exactly the
+    block-major paged layout. Block 0 is conventionally the trash block
+    (``serving.paging.BlockAllocator``): idle slots' tables point at
+    it, so masked garbage writes land where no request reads."""
+    return init_cache(model, int(pool_blocks), int(block_size))
+
+
+def _pool_block_size(pool) -> int:
+    """Static block size from the pool's K/V leaf shapes."""
+    for leaf in jax.tree_util.tree_leaves(pool):
+        if getattr(leaf, "ndim", 0) == 4:
+            return leaf.shape[2]
+    raise ValueError("pool holds no 4-D K/V leaves")
+
+
+def _gather_view(pool, tables):
+    """Dense per-slot cache view through the block tables:
+    ``[P, Hkv, bs, hd]`` pool leaves + ``[S, MB]`` tables →
+    ``[S, Hkv, MB*bs, hd]`` rows (scalar leaves → zeros placeholders,
+    keeping the cache pytree structure apply() expects)."""
+    def g(leaf):
+        if getattr(leaf, "ndim", 0) == 4:
+            v = leaf[tables]                       # [S, MB, Hkv, bs, hd]
+            v = jnp.transpose(v, (0, 2, 1, 3, 4))  # [S, Hkv, MB, bs, hd]
+            return v.reshape(v.shape[0], v.shape[1], -1, v.shape[4])
+        return jnp.zeros((), jnp.int32)
+
+    return jax.tree_util.tree_map(g, pool)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("model", "temperature", "top_k", "top_p"),
+    donate_argnames=("pool",))
+def paged_slot_decode_step(model, params, pool, tables, tokens, slot_cur,
+                           pad_lens, rng, *, temperature: float = 0.0,
+                           top_k: int = 0, top_p: float = 1.0):
+    """One in-flight decode iteration over the BLOCK-TABLE cache: every
+    slot advances one token at its own fill index, reading its cache
+    through ``tables`` (``[num_slots, max_blocks]`` int32, traced) and
+    writing exactly its one new position back into the pool.
+
+    Compiled ONCE per (num_slots, max_blocks, pool_blocks) — block
+    allocation, frees, grafts and refills mutate the (traced) tables,
+    never the program. Idle or block-stalled slots' writes land at
+    whatever their table names at the frontier — the engine parks those
+    entries on the trash block, so the masked garbage is contained.
+    Returns ``(next_tokens [num_slots] int32, pool)``.
+    """
+    bs = _pool_block_size(pool)
+    dense = _gather_view(pool, tables)
+    logits, mut = model.apply({"params": params, "cache": dense},
+                              tokens[:, None], decode=True,
+                              pad_lens=pad_lens, slot_cur=slot_cur,
+                              mutable=["cache"])
+    blk = jnp.take_along_axis(tables, (slot_cur // bs)[:, None],
+                              axis=1)[:, 0]               # [S] physical
+    off = slot_cur % bs
+
+    def scatter(pool_leaf, dense_leaf):
+        if getattr(pool_leaf, "ndim", 0) != 4:
+            return pool_leaf
+        new = jnp.take_along_axis(
+            dense_leaf, slot_cur[:, None, None, None],
+            axis=2)[:, :, 0, :]                           # [S, Hkv, hd]
+        return pool_leaf.at[blk, :, off, :].set(
+            new.astype(pool_leaf.dtype))
+
+    pool = jax.tree_util.tree_map(scatter, pool, mut["cache"])
+    nxt = _sample(logits[:, -1].astype(jnp.float32), rng, temperature,
+                  top_k, top_p)
+    return nxt, pool
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "window", "temperature", "top_k", "top_p"),
+    donate_argnames=("pool",))
+def paged_prefill_chunk_into_slot(model, params, chunk_ids, pool,
+                                  table_row, offset, n_valid, rng, *,
+                                  window: int,
+                                  temperature: float = 0.0,
+                                  top_k: int = 0, top_p: float = 1.0):
+    """``prefill_chunk_into_slot`` through a block table: consume ``C``
+    zero-aligned prompt tokens at logical positions
+    ``[offset, offset + C)`` of the slot whose table is ``table_row``
+    (``[max_blocks]`` int32, traced). The chunk attends a dense view of
+    the table's first ``ceil(window / block_size)`` blocks — ``window``
+    (static, a chunk multiple covering the request's aligned prompt
+    length) bounds the gather exactly like the per-slot variant's
+    window bounds its slice — and scatters only its own C written
+    positions back through the table, so a grafted shared-prefix block
+    is READ here, never written. One compiled program per
+    (C, window-blocks); slot identity rides entirely in the table.
+    Returns ``(tok [1] int32, pool)`` — the last-real-position sample,
+    meaningful on the final chunk."""
+    bs = _pool_block_size(pool)
+    c = chunk_ids.shape[1]
+    # The VIEW must span the whole window (>= offset + C for every
+    # chunk of the plan): the multi-call decode path writes the chunk
+    # at [offset, offset+C) with dynamic_update_slice, which CLAMPS a
+    # write extending past the view — sliding it back over committed
+    # prompt rows. A window past the table (a resume whose chunk-
+    # aligned length exceeds max_len) gathers every table block and
+    # pads the view with scratch rows instead: writes land in-place,
+    # and only real positions scatter back to the pool.
+    wb = -(-int(window) // bs)
+
+    def gather(leaf):
+        if getattr(leaf, "ndim", 0) == 4:
+            mbv = min(wb, table_row.shape[0])
+            v = leaf[table_row[:mbv]]              # [mbv, Hkv, bs, hd]
+            v = jnp.transpose(v, (1, 0, 2, 3))
+            v = v.reshape(1, leaf.shape[1], mbv * bs, leaf.shape[3])
+            if wb > mbv:
+                v = jnp.concatenate(
+                    [v, jnp.zeros((1, leaf.shape[1], (wb - mbv) * bs,
+                                   leaf.shape[3]), v.dtype)], axis=2)
+            return v
+        # scalar idx leaves: pin the multi-call decode path's write
+        # index at the chunk's offset (same contract as the un-paged
+        # chunk primitive)
+        return jnp.asarray(offset, jnp.int32)
+
+    row = jax.tree_util.tree_map(gather, pool)
+    logits, mut = model.apply({"params": params, "cache": row},
+                              chunk_ids, decode=True, mutable=["cache"])
+    pos = offset + jnp.arange(c)                   # [C] logical
+    bi = pos // bs
+    mb = table_row.shape[0]
+    # Only REAL tokens' rows are persisted: the final chunk's pad tail
+    # (pos >= offset + n_valid) and anything past the table route to
+    # the trash block 0 — never clamp onto a live block (a resume
+    # whose chunk-aligned length pads past max_len would otherwise
+    # scatter garbage over committed rows), and pad-only blocks then
+    # need no allocation at all (the reservation covers real rows +
+    # one decode block; decode's first write lands at the frontier
+    # before any attention can read it — the PR 9 invariant).
+    real = (pos < offset + n_valid) & (bi < mb)
+    blk = jnp.where(real, table_row[jnp.minimum(bi, mb - 1)], 0)
+    off = pos % bs
+
+    def scatter(pool_leaf, dense_leaf):
+        if getattr(pool_leaf, "ndim", 0) != 4:
+            return pool_leaf
+        new = jnp.take_along_axis(
+            dense_leaf, pos[None, None, :, None], axis=2)[0]
+        new = jnp.moveaxis(new, 1, 0)              # [C, Hkv, hd]
+        return pool_leaf.at[blk, :, off, :].set(
+            new.astype(pool_leaf.dtype))
+
+    pool = jax.tree_util.tree_map(scatter, pool, mut["cache"])
+    last = jax.lax.dynamic_slice(
+        logits, (0, jnp.maximum(n_valid - 1, 0), 0),
+        (1, 1, logits.shape[2]))[:, 0]
+    tok = _sample(last.astype(jnp.float32), rng, temperature, top_k, top_p)
+    return tok, pool
+
+
+@functools.partial(
+    jax.jit, static_argnames=("model", "temperature", "top_k", "top_p"),
+    donate_argnames=("pool",))
+def paged_prefill_into_slot(model, params, prompt_ids, pad_len, pool,
+                            table_row, rng, *, temperature: float = 0.0,
+                            top_k: int = 0, top_p: float = 1.0):
+    """``prefill_into_slot`` through a block table — the blocking
+    (whole-prompt, left-padded bucket) refill for paged backends: the
+    prompt runs the standard first-chunk prefill against a private
+    ``[1, Lb]`` scratch cache, then every one of its ``Lb`` rows
+    scatters to the pool position the table names (left-pad rows
+    included — they carry the same masked-garbage contract as the
+    per-slot variant). Compiled once per bucket length; returns
+    ``(first_token [1] int32, pool)``."""
+    bs = _pool_block_size(pool)
+    lb = prompt_ids.shape[1]
+    small_shapes = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, lb), jnp.int32), decode=True))
+    small = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), small_shapes["cache"])
+    logits, mut = model.apply({"params": params, "cache": small},
+                              prompt_ids, decode=True, pad_lens=pad_len,
+                              first_chunk=True, mutable=["cache"])
+    pos = jnp.arange(lb)
+    blk = table_row[pos // bs]
+    off = pos % bs
+
+    def scatter(pool_leaf, sm):
+        if getattr(sm, "ndim", 0) != 4:
+            return pool_leaf
+        new = jnp.transpose(sm[0], (1, 0, 2))      # [Lb, Hkv, hd]
+        return pool_leaf.at[blk, :, off, :].set(
+            new.astype(pool_leaf.dtype))
+
+    pool = jax.tree_util.tree_map(scatter, pool, mut["cache"])
+    tok = _sample(logits[:, -1].astype(jnp.float32), rng, temperature,
+                  top_k, top_p)
+    return tok, pool
+
+
+@functools.partial(jax.jit, donate_argnames=("pool",))
+def copy_pool_block(pool, src, dst):
+    """Copy one physical block's K/V (every layer) — the paged
+    copy-on-write primitive: a write that would land in a SHARED block
+    (refcount >= 2 after a radix graft) first duplicates it so the
+    other holders keep reading the original. ``src``/``dst`` traced —
+    one tiny compiled program per pool signature."""
+    def cp(leaf):
+        if getattr(leaf, "ndim", 0) != 4:
+            return leaf
+        row = jax.lax.dynamic_slice(
+            leaf, (src, 0, 0, 0),
+            (1,) + leaf.shape[1:])
+        return jax.lax.dynamic_update_slice(leaf, row, (dst, 0, 0, 0))
+
+    return jax.tree_util.tree_map(cp, pool)
+
+
+# ---------------------------------------------------------------------------
 # LoRA training utilities
 # ---------------------------------------------------------------------------
 

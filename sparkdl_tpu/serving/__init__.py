@@ -10,11 +10,14 @@ from .engine import (PREFILLING, EngineStopped, GenerationEngine,
                      QueueFullError, Request, RequestQuarantined,
                      RequestRejected, ServingError, ServingStallError,
                      StubBackend, bucket_length)
-from .prefix import PrefixCache
+from .paging import (BlockAllocator, BlockError, BlockExhausted,
+                     PagedBlockManager)
+from .prefix import PrefixCache, RadixPrefixCache
 
 __all__ = [
     "GenerationEngine", "Request", "StubBackend", "bucket_length",
     "ServingError", "RequestRejected", "QueueFullError",
     "RequestQuarantined", "ServingStallError", "EngineStopped",
-    "PREFILLING", "PrefixCache",
+    "PREFILLING", "PrefixCache", "RadixPrefixCache", "BlockAllocator",
+    "BlockError", "BlockExhausted", "PagedBlockManager",
 ]

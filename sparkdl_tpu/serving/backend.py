@@ -30,6 +30,16 @@ which the next chunk (or the request's own first decode step)
 overwrites before any attention can read it. Parking a mid-prefill
 slot anywhere *below* its frontier would clobber committed prompt K/V.
 
+**Paged variant (ISSUE 11).** :class:`PagedLlamaSlotBackend` replaces
+the per-slot ``max_len`` rows with block tables over ONE shared K/V
+pool (``models.llama`` paged primitives): per-request HBM is the
+blocks actually touched, shared prompt heads are pointer grafts
+(:class:`serving.prefix.RadixPrefixCache` — zero-copy commits AND
+hits), and allocation policy lives in the jax-free
+:class:`serving.paging.PagedBlockManager`, the same object the
+``StubBackend`` mirror rides, so the scheduler-visible behavior cannot
+drift between the two.
+
 **Shared-prefix KV reuse.** When ``SPARKDL_SERVE_PREFIX_CACHE_MB`` > 0
 (default 64), every completed chunked prefill commits its prompt's
 K/V rows (chunk-aligned row count, so the copy programs stay bounded)
@@ -59,6 +69,7 @@ import logging
 
 from ..core.runtime import GLOBAL_COMPILE_CACHE
 from ..models import llama as L
+from .paging import PagedBlockManager
 from .prefix import (PrefixCache, prefix_cache_budget_bytes,
                      usable_reuse)
 
@@ -356,6 +367,245 @@ class LlamaSlotBackend:
         """Retire hook: park the slot at fill index 0 (its stale cache
         rows are dead — a future refill overwrites [0, bucket) and masks
         everything past its own fill index)."""
+        self._cur[slot] = 0
+        self._pads[slot] = 0
+        self._tokens[slot] = 0
+
+
+def pool_bytes_per_block(model, block_size: int) -> int:
+    """K/V bytes one physical block costs across every layer — the
+    ``SPARKDL_SERVE_KV_POOL_MB`` → block-count conversion. Derived via
+    ``eval_shape`` over a 1-block pool (no parameter compute, no
+    allocation)."""
+    import jax as _jax
+    shapes = _jax.eval_shape(
+        lambda: L.init_paged_pool(model, 1, int(block_size)))
+    return sum(int(np.prod(s.shape)) * s.dtype.itemsize
+               for s in _jax.tree_util.tree_leaves(shapes)
+               if len(getattr(s, "shape", ())) == 4)
+
+
+class PagedLlamaSlotBackend(LlamaSlotBackend):
+    """Block-table slot backend (ISSUE 11): one shared K/V pool of
+    ``pool_blocks`` physical blocks, a ``[num_slots, max_blocks]``
+    int32 block table, a jax-free :class:`serving.paging.BlockAllocator`
+    (free list + refcounts + copy-on-write), and block-granular radix
+    prefix sharing (:class:`serving.prefix.RadixPrefixCache`) whose
+    hits are table pointer grafts — zero K/V bytes copied.
+
+    ``self.cache`` *is* the pool (keeping the attribute name keeps the
+    donated-cache loss guard ``_guarded`` working unchanged). Slot
+    tables and the allocator live host-side; a slot's logical row
+    ``[0, max_len)`` maps through its table, unallocated entries point
+    at the reserved trash block 0 so masked garbage writes (idle /
+    block-stalled slots) land where no request reads.
+
+    Sizing: ``pool_blocks`` directly, or ``kv_pool_mb`` converted via
+    :func:`pool_bytes_per_block`; the default matches the un-paged
+    footprint (``num_slots × ceil(max_len / block_size)`` + trash) so
+    paging is a strict generalization — over-subscription comes from
+    raising ``num_slots`` against a FIXED pool, which is the point.
+    """
+
+    paged = True
+
+    def __init__(self, model, variables, num_slots: int, max_len: int, *,
+                 block_size: int = 16, pool_blocks: int | None = None,
+                 kv_pool_mb: float | None = None,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, seed: int = 0,
+                 prefix_cache_bytes: int | None = None):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if max_len < 2:
+            raise ValueError(f"max_len must be >= 2, got {max_len}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.model = model
+        self.params = variables["params"] if "params" in variables \
+            else variables
+        self.num_slots = int(num_slots)
+        self.block_size = int(block_size)
+        self.max_blocks = -(-int(max_len) // self.block_size)
+        self.max_len = self.max_blocks * self.block_size
+        self.vocab_size = int(model.cfg.vocab_size)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        if pool_blocks is None and kv_pool_mb is not None:
+            per = pool_bytes_per_block(model, self.block_size)
+            pool_blocks = max(2, int(kv_pool_mb * 2 ** 20) // per)
+        budget = prefix_cache_budget_bytes() if prefix_cache_bytes is None \
+            else max(0, int(prefix_cache_bytes))
+        self.tables = np.zeros((self.num_slots, self.max_blocks),
+                               np.int32)  # 0 = trash block
+        # Radix entries are pool blocks, not byte payloads: the MB knob
+        # only gates sharing on/off here (the pool itself is the budget,
+        # reclaimed LRU-first when allocation runs short).
+        self.mgr = PagedBlockManager(
+            self.num_slots, self.max_len, self.block_size, pool_blocks,
+            radix=budget > 0,
+            on_table=self._set_table, copy_block=self._copy_block)
+        self.pool_blocks = self.mgr.pool_blocks
+        self.cache = L.init_paged_pool(model, self.pool_blocks,
+                                       self.block_size)
+        self.allocator = self.mgr.allocator
+        self.radix = self.mgr.radix
+        self._tokens = np.zeros(self.num_slots, np.int32)
+        self._cur = np.zeros(self.num_slots, np.int32)
+        self._pads = np.zeros(self.num_slots, np.int32)
+        self._rng = jax.random.PRNGKey(seed)
+        self._step_i = 0
+        self._prefill_i = 0
+        self.prefix_cache = None  # the byte-payload LRU does not apply
+        self._warned_commit = False
+
+    # -- allocation plumbing (policy lives in PagedBlockManager) ----------
+    def _set_table(self, slot: int, idx: int, block: int) -> None:
+        self.tables[slot, idx] = block
+
+    def _copy_block(self, src: int, dst: int) -> None:
+        GLOBAL_COMPILE_CACHE.note("serve_pool_cow", _tree_sig(self.cache))
+        self.cache = self._guarded(L.copy_pool_block, self.cache,
+                                   jnp.int32(src), jnp.int32(dst))
+
+    def can_reserve(self, n: int) -> bool:
+        return self.mgr.can_reserve(n)
+
+    def ensure_block_for(self, slot: int, pos: int) -> bool:
+        return self.mgr.ensure_block_for(slot, pos)
+
+    def drain_alloc_samples(self) -> list[float]:
+        return self.mgr.drain_alloc_samples()
+
+    def pool_stats(self) -> dict:
+        return self.mgr.pool_stats()
+
+    def prefix_stats(self) -> dict | None:
+        return self.mgr.prefix_stats()
+
+    # -- engine protocol --------------------------------------------------
+    def prefill(self, slot: int, prompt, bucket: int) -> int:
+        """Blocking whole-prompt refill through the table. Left-padded
+        layout is not zero-aligned, so the blocking path never radix-
+        shares — it still pages (bucket + 1 decode block allocated, the
+        rest grows on demand)."""
+        if bucket > self.max_len:
+            raise ValueError(f"bucket {bucket} > max_len {self.max_len}")
+        self.mgr.reserve_bucket(slot, bucket)
+        ids, pad = L.left_pad_prompts([list(prompt)], pad_to=bucket)
+        ids_arr, pad_arr = jnp.asarray(ids), jnp.asarray(pad)
+        row = jnp.asarray(self.tables[slot])
+        GLOBAL_COMPILE_CACHE.note(
+            "serve_prefill",
+            (_tree_sig((ids_arr, pad_arr, row)), _tree_sig(self.cache),
+             self.temperature, self.top_k, self.top_p))
+        key = self._rng if self.temperature <= 0.0 else \
+            jax.random.fold_in(self._rng, (1 << 20) + self._prefill_i)
+        self._prefill_i += 1
+        tok, self.cache = self._guarded(
+            L.paged_prefill_into_slot, self.model, self.params, ids_arr,
+            pad_arr, self.cache, row, key, temperature=self.temperature,
+            top_k=self.top_k, top_p=self.top_p)
+        tok = int(np.asarray(tok)[0])
+        self._tokens[slot] = tok
+        self._cur[slot] = bucket
+        self._pads[slot] = int(pad[0])
+        return tok
+
+    def begin_prefill(self, slot: int, prompt, chunk: int) -> int:
+        """Arm a chunked (zero-aligned) prefill: radix-graft the longest
+        cached full-block head (table pointers + refcounts, no copy),
+        then allocate private blocks covering the chunk-aligned
+        remainder + one decode block. Raises
+        :class:`serving.paging.BlockExhausted` when the pool cannot
+        cover it (graft refs rolled back) — the engine requeues the
+        request and waits."""
+        self._pads[slot] = 0
+        self._tokens[slot] = 0
+        self._cur[slot] = 0
+        reuse = self.mgr.reserve_prompt(slot, prompt, chunk)
+        self._cur[slot] = reuse  # frontier: tail chunks start here
+        return reuse
+
+    def prefill_chunk(self, slot: int, chunk, offset: int,
+                      n_valid: int, window: int | None = None) -> int:
+        ids = jnp.asarray(np.asarray(chunk, np.int32)[None, :])
+        # window is NOT clamped to max_len: a resume's chunk-aligned
+        # plan can overhang the slot row, and the paged primitive pads
+        # the attention view with scratch rows past the table instead
+        # of letting dynamic_update_slice clamp the chunk's write back
+        # over committed rows. Cap only against a runaway caller.
+        window = self.max_len if window is None \
+            else min(int(window), self.max_len + len(chunk))
+        row = jnp.asarray(self.tables[slot])
+        wb = -(-window // self.block_size)
+        GLOBAL_COMPILE_CACHE.note(
+            "serve_prefill_chunk",
+            (_tree_sig((ids, row)), _tree_sig(self.cache), wb,
+             self.temperature, self.top_k, self.top_p))
+        key = self._rng if self.temperature <= 0.0 else \
+            jax.random.fold_in(self._rng, (1 << 20) + self._prefill_i)
+        self._prefill_i += 1
+        tok, self.cache = self._guarded(
+            L.paged_prefill_chunk_into_slot, self.model, self.params,
+            ids, self.cache, row, jnp.int32(offset), jnp.int32(n_valid),
+            key, window=wb * self.block_size,
+            temperature=self.temperature, top_k=self.top_k,
+            top_p=self.top_p)
+        self._cur[slot] = offset + len(chunk)
+        return int(np.asarray(tok)[0])
+
+    def finish_prefill(self, slot: int, prompt, last_tok: int,
+                       aligned_len: int, commit: bool = True) -> int:
+        """Complete a chunked prefill. The radix commit is ZERO-COPY —
+        the prompt's full blocks are already in the pool, the trie just
+        takes a reference on each — so unlike the gather-copy LRU there
+        is no copy economy to police: commit whenever sharing is on."""
+        self._tokens[slot] = int(last_tok)
+        self._cur[slot] = len(prompt)
+        self._pads[slot] = 0
+        if commit:
+            try:
+                self.mgr.commit(slot, prompt)
+            except Exception as e:  # noqa: BLE001 — caching is an
+                if not self._warned_commit:  # optimization, never fatal
+                    self._warned_commit = True
+                    log.warning("radix commit failed (%s: %s); "
+                                "suppressing further warnings",
+                                type(e).__name__, e)
+        return int(last_tok)
+
+    def step(self, active_slots) -> list[int]:
+        tok_arr = jnp.asarray(self._tokens)
+        cur_arr = jnp.asarray(self._cur)
+        pads_arr = jnp.asarray(self._pads)
+        tables_arr = jnp.asarray(self.tables)
+        GLOBAL_COMPILE_CACHE.note(
+            "serve_decode_step",
+            (_tree_sig((tok_arr, cur_arr, pads_arr, tables_arr)),
+             _tree_sig(self.cache), self.temperature, self.top_k,
+             self.top_p))
+        key = self._rng if self.temperature <= 0.0 else \
+            jax.random.fold_in(self._rng, self._step_i)
+        self._step_i += 1
+        nxt, self.cache = self._guarded(
+            L.paged_slot_decode_step, self.model, self.params,
+            self.cache, tables_arr, tok_arr, cur_arr, pads_arr, key,
+            temperature=self.temperature, top_k=self.top_k,
+            top_p=self.top_p)
+        nxt = np.asarray(nxt).astype(np.int32)
+        active = np.asarray(sorted(active_slots), np.int32)
+        self._cur[active] += 1
+        self._tokens[active] = nxt[active]
+        return nxt.tolist()
+
+    def release(self, slot: int):
+        """Retire/evict/quarantine hook: drop every table reference
+        (blocks return to the free list at refcount 0 — radix-cached
+        ones stay resident on the trie's reference) and park the table
+        on the trash block."""
+        self.mgr.release(slot)
         self._cur[slot] = 0
         self._pads[slot] = 0
         self._tokens[slot] = 0

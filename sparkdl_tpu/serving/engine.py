@@ -31,11 +31,29 @@ iteration:
    ``StageAccountant``) records exactly how much wall time RUNNING
    slots spent not decoding while prefill work ran.
 
+**Paged KV (ISSUE 11).** A backend with ``paged = True`` (the block-
+table backends over one shared K/V pool) changes three scheduler
+rules: admission additionally requires the pool to cover the prompt's
+blocks + one decode block (a queue head it cannot cover WAITS, FIFO —
+``admission_block_waits``); decode growth allocates blocks lazily at
+each slot's write frontier, and a slot the pool cannot serve sits the
+iteration out (``block_stall_events``) — only when EVERY running slot
+stalls is the newest request preempted (released + requeued to resume
+as ``prompt + tokens-so-far``; greedy output unchanged, nothing
+re-emitted); and the per-iteration prefill pacing generalizes from one
+chunk to a TOKEN budget (``SPARKDL_SERVE_PREFILL_BUDGET``) spent
+round-robin oldest-first across every PREFILLING slot, so one
+iteration can complete several refills — the admission-rate unlock
+high-churn mixes need. Exhaustion is always backpressure:
+``RequestRejected`` fires only for requests that can NEVER fit.
+
 Design split: this module is **jax-free** — the scheduler, queue, slot
 table, request state machine, streaming callbacks, and failure policy
 are all plain Python against a duck-typed backend (``prefill(slot,
 prompt, bucket) -> first_token``, ``step(active_slots) -> tokens [num_
-slots]``), so the whole scheduling layer unit-tests without a device.
+slots]``), so the whole scheduling layer unit-tests without a device
+(``serving.paging`` — allocator, block manager, radix trie — is
+jax-free too, and ``StubBackend`` mirrors the full paged protocol).
 The jax half is ``serving.backend.LlamaSlotBackend`` (lazily imported
 by :meth:`GenerationEngine.from_model`); :class:`StubBackend` here is
 the deterministic jax-free stand-in the scheduler tests and the
@@ -73,12 +91,13 @@ import threading
 import time
 
 from ..runner import events, telemetry
+from .paging import BlockExhausted
 
 __all__ = [
     "GenerationEngine", "Request", "StubBackend", "bucket_length",
     "ServingError", "RequestRejected", "QueueFullError",
     "RequestQuarantined", "ServingStallError", "EngineStopped",
-    "PREFILLING",
+    "PREFILLING", "BlockExhausted",
 ]
 
 log = logging.getLogger("sparkdl_tpu.serving")
@@ -91,6 +110,14 @@ STALL_ENV = "SPARKDL_SERVE_STALL_S"
 MIN_BUCKET_ENV = "SPARKDL_SERVE_MIN_BUCKET"
 CHUNK_ENV = "SPARKDL_SERVE_PREFILL_CHUNK"
 STALL_FREE_ENV = "SPARKDL_SERVE_STALL_FREE"
+# ISSUE 11 — paged KV + multi-chunk prefill budgets. PREFILL_CHUNK
+# stays the per-CHUNK size (one jitted call's token count);
+# PREFILL_BUDGET owns admission pacing: tokens of prefill work per
+# engine iteration, spread round-robin (oldest admitted first) across
+# every PREFILLING slot. Default = one chunk — exact PR 9 behavior.
+PREFILL_BUDGET_ENV = "SPARKDL_SERVE_PREFILL_BUDGET"
+BLOCK_SIZE_ENV = "SPARKDL_SERVE_BLOCK_SIZE"
+KV_POOL_MB_ENV = "SPARKDL_SERVE_KV_POOL_MB"
 
 _DEFAULT_SLOTS = 8
 _DEFAULT_MAX_LEN = 2048
@@ -98,6 +125,11 @@ _DEFAULT_QUEUE_CAP = 128
 _DEFAULT_RETRIES = 1
 _DEFAULT_MIN_BUCKET = 16
 _DEFAULT_CHUNK = 32
+# Block-allocation-latency-shaped bounds (seconds): a free-list pop is
+# microseconds; radix-eviction reclaims and CoW copies push into the
+# ms range — the histogram's job is to show when allocation stops
+# being free.
+_ALLOC_BUCKETS = (1e-5, 5e-5, 2e-4, 1e-3, 5e-3, 0.02, 0.1, 0.5)
 
 # Request-latency-shaped histogram bounds (seconds). The telemetry
 # default buckets top out at 10s (span-duration-shaped) — a long-tail
@@ -209,6 +241,14 @@ class Request:
         self.next_chunk = 0       # committed chunks resume from here
         self.prefill_reused = 0   # prefix-cache tokens skipped
         self.prefill_spent_s = 0.0
+        # paged mode: the slot's write frontier (next decode write
+        # position — drives lazy block growth), preemption count, and
+        # the length actually prefilled (prompt + already-generated
+        # tokens after a preemption resume)
+        self.write_pos = 0
+        self.preemptions = 0
+        self.served_len = len(self.prompt)
+        self._block_stalled = False
         self._done = threading.Event()
 
     # -- caller-side API --------------------------------------------------
@@ -266,7 +306,9 @@ class StubBackend:
                  vocab_size: int = 32000, step_s: float = 0.0,
                  prefill_s: float = 0.0, prefill_tok_s: float = 0.0,
                  seed: int = 0, prefix_cache_bytes: int | None = None,
-                 prefix_bytes_per_token: int = 1024):
+                 prefix_bytes_per_token: int = 1024,
+                 block_size: int | None = None,
+                 pool_blocks: int | None = None):
         from .prefix import PrefixCache, prefix_cache_budget_bytes
         self.num_slots = num_slots
         self.max_len = max_len
@@ -279,12 +321,31 @@ class StubBackend:
         self._state = [(0, 0)] * num_slots  # (prompt_key, n_emitted)
         budget = prefix_cache_budget_bytes() if prefix_cache_bytes is None \
             else max(0, int(prefix_cache_bytes))
-        self.prefix_cache = PrefixCache(budget) if budget > 0 else None
+        # Paged mirror (ISSUE 11): block_size arms the SAME
+        # PagedBlockManager the llama backend rides — slot block lists,
+        # radix grafts, CoW and release bookkeeping are the one shared
+        # implementation, only the K/V bytes are absent. The byte-
+        # payload PrefixCache is replaced by the manager's radix trie.
+        self.paged = bool(block_size)
+        if self.paged:
+            from .paging import PagedBlockManager
+            self.mgr = PagedBlockManager(num_slots, max_len, block_size,
+                                         pool_blocks, radix=budget > 0)
+            self.block_size = self.mgr.block_size
+            self.max_blocks = self.mgr.max_blocks
+            self.max_len = self.mgr.max_len
+            self.pool_blocks = self.mgr.pool_blocks
+            self.allocator = self.mgr.allocator
+            self.prefix_cache = None
+        else:
+            self.prefix_cache = PrefixCache(budget) if budget > 0 else None
 
     def _tok(self, key: int, n: int) -> int:
         return (self.seed + key * 31 + n * 7) % self.vocab_size
 
     def prefill(self, slot: int, prompt, bucket: int) -> int:
+        if self.paged:
+            self.mgr.reserve_bucket(slot, bucket)  # BlockExhausted OK
         if self.prefill_s or self.prefill_tok_s:
             time.sleep(self.prefill_s + self.prefill_tok_s * bucket)
         key = sum(prompt) + len(prompt)
@@ -295,6 +356,8 @@ class StubBackend:
     def begin_prefill(self, slot: int, prompt, chunk: int) -> int:
         from .prefix import usable_reuse
         self._state[slot] = (0, 0)
+        if self.paged:
+            return self.mgr.reserve_prompt(slot, prompt, chunk)
         if self.prefix_cache is None:
             return 0
         key, n_cached, _payload = self.prefix_cache.lookup(prompt)
@@ -316,15 +379,38 @@ class StubBackend:
                        aligned_len: int, commit: bool = True) -> int:
         key = sum(prompt) + len(prompt)
         self._state[slot] = (key, 1)
-        if commit and self.prefix_cache is not None:
-            self.prefix_cache.put(
-                tuple(prompt), tuple(prompt),
-                len(prompt) * self.prefix_bytes_per_token)
+        if commit:
+            if self.paged:
+                self.mgr.commit(slot, prompt)
+            elif self.prefix_cache is not None:
+                self.prefix_cache.put(
+                    tuple(prompt), tuple(prompt),
+                    len(prompt) * self.prefix_bytes_per_token)
         return self._tok(key, 0)
 
     def prefix_stats(self) -> dict | None:
+        if self.paged:
+            return self.mgr.prefix_stats()
         return None if self.prefix_cache is None else \
             self.prefix_cache.stats()
+
+    # -- paged protocol (bookkeeping only — no K/V bytes) -----------------
+    def can_reserve(self, n: int) -> bool:
+        return self.mgr.can_reserve(n)
+
+    def ensure_block_for(self, slot: int, pos: int) -> bool:
+        return self.mgr.ensure_block_for(slot, pos)
+
+    def pool_stats(self) -> dict:
+        return self.mgr.pool_stats()
+
+    def drain_alloc_samples(self) -> list[float]:
+        return self.mgr.drain_alloc_samples()
+
+    def release(self, slot: int):
+        if self.paged:
+            self.mgr.release(slot)
+        self._state[slot] = (0, 0)
 
     def step(self, active_slots) -> list[int]:
         if self.step_s:
@@ -352,9 +438,14 @@ class GenerationEngine:
                  stall_s: float | None = None,
                  min_bucket: int | None = None,
                  stall_free: bool | None = None,
-                 prefill_chunk: int | None = None):
+                 prefill_chunk: int | None = None,
+                 prefill_budget: int | None = None):
         self.backend = backend
         self.eos_id = eos_id
+        # Paged backend (ISSUE 11): admission additionally gates on KV-
+        # pool blocks, decode growth allocates lazily, exhaustion
+        # backpressures (the request waits) instead of crashing.
+        self.paged = bool(getattr(backend, "paged", False))
         # Stall-free scheduling (SPARKDL_SERVE_STALL_FREE, default on):
         # prompts are consumed in fixed-size chunks interleaved with the
         # decode step instead of blocking it for a whole O(L^2) prefill.
@@ -372,6 +463,22 @@ class GenerationEngine:
                                  if prefill_chunk is not None
                                  else _env_num(CHUNK_ENV, _DEFAULT_CHUNK))
         self.prefill_chunk = min(self.prefill_chunk, backend.max_len)
+        if self.paged:
+            # Radix grafts are whole blocks and chunk plans start at
+            # chunk multiples: align the chunk to the block size so a
+            # block-aligned reuse offset is always plan-legal.
+            bs = int(backend.block_size)
+            self.prefill_chunk = max(bs, (self.prefill_chunk // bs) * bs)
+        # The per-iteration prefill TOKEN budget (ISSUE 11): how many
+        # prompt tokens may be consumed per engine iteration, spread one
+        # chunk at a time round-robin (oldest admitted first) over every
+        # PREFILLING slot. Default = one chunk — the exact PR 9 pacing;
+        # raising it lets one iteration refill several slots, removing
+        # the ~1 admission/iteration cap high-churn mixes starve under.
+        self.prefill_budget = max(
+            self.prefill_chunk,
+            prefill_budget if prefill_budget is not None
+            else _env_num(PREFILL_BUDGET_ENV, self.prefill_chunk))
         # Floor 1: capacity 0 would make every blocking submit() spin
         # forever on `len(queue) >= 0` with no exit condition.
         self.queue_capacity = max(1, queue_capacity
@@ -400,6 +507,13 @@ class GenerationEngine:
             "peak_queue_depth": 0, "peak_slots_busy": 0,
             "callback_errors": 0, "prefill_chunks": 0,
             "decode_stall_s": 0.0, "decode_stall_events": 0,
+            # paged-mode ledger: iterations where the queue head waited
+            # for pool blocks (admission backpressure), decode steps a
+            # RUNNING slot sat out waiting for a growth block, and
+            # preemptions (the deadlock-breaking requeue of the newest
+            # request when EVERY running slot is block-stalled)
+            "admission_block_waits": 0, "block_stall_events": 0,
+            "preemptions": 0,
         }
 
     # -- construction -----------------------------------------------------
@@ -409,21 +523,45 @@ class GenerationEngine:
                    top_k: int = 0, top_p: float = 1.0, seed: int = 0,
                    eos_id: int | None = None,
                    prefix_cache_mb: float | None = None,
+                   block_size: int | None = None,
+                   pool_blocks: int | None = None,
+                   kv_pool_mb: float | None = None,
                    **kw) -> "GenerationEngine":
         """Build an engine over :class:`serving.backend.LlamaSlotBackend`
         (the jax import happens here, not at module import).
         ``prefix_cache_mb`` overrides ``SPARKDL_SERVE_PREFIX_CACHE_MB``
-        (0 disables shared-prefix KV reuse)."""
-        from .backend import LlamaSlotBackend  # deferred: jax
+        (0 disables shared-prefix KV reuse).
+
+        ``block_size`` > 0 (or ``SPARKDL_SERVE_BLOCK_SIZE``) selects the
+        PAGED backend (ISSUE 11): one shared K/V pool of ``pool_blocks``
+        blocks (or ``kv_pool_mb`` / ``SPARKDL_SERVE_KV_POOL_MB``
+        converted; default = the un-paged footprint) addressed through
+        per-slot block tables, with block-granular radix prefix sharing
+        instead of the copy-based LRU."""
         num_slots = num_slots if num_slots is not None \
             else _env_num(SLOTS_ENV, _DEFAULT_SLOTS)
         max_len = max_len if max_len is not None \
             else _env_num(MAX_LEN_ENV, _DEFAULT_MAX_LEN)
-        backend = LlamaSlotBackend(
-            model, variables, num_slots, max_len, temperature=temperature,
-            top_k=top_k, top_p=top_p, seed=seed,
-            prefix_cache_bytes=None if prefix_cache_mb is None
-            else int(prefix_cache_mb * 2 ** 20))
+        block_size = block_size if block_size is not None \
+            else _env_num(BLOCK_SIZE_ENV, 0)
+        pbytes = None if prefix_cache_mb is None \
+            else int(prefix_cache_mb * 2 ** 20)
+        if block_size and block_size > 0:
+            from .backend import PagedLlamaSlotBackend  # deferred: jax
+            kv_pool_mb = kv_pool_mb if kv_pool_mb is not None \
+                else _env_num(KV_POOL_MB_ENV, None, float)
+            backend = PagedLlamaSlotBackend(
+                model, variables, num_slots, max_len,
+                block_size=int(block_size), pool_blocks=pool_blocks,
+                kv_pool_mb=kv_pool_mb, temperature=temperature,
+                top_k=top_k, top_p=top_p, seed=seed,
+                prefix_cache_bytes=pbytes)
+        else:
+            from .backend import LlamaSlotBackend  # deferred: jax
+            backend = LlamaSlotBackend(
+                model, variables, num_slots, max_len,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                seed=seed, prefix_cache_bytes=pbytes)
         return cls(backend, eos_id=eos_id, **kw)
 
     # -- telemetry helpers ------------------------------------------------
@@ -500,6 +638,25 @@ class GenerationEngine:
                     f"bucketed prompt ({bucket}) + max_new_tokens "
                     f"({max_new_tokens}) exceeds max_len "
                     f"{self.backend.max_len}")
+        if self.paged:
+            # Reject only what can NEVER fit — a request whose lifetime
+            # block footprint exceeds the whole pool would wait forever;
+            # anything smaller waits for blocks (backpressure, below).
+            # Chunked mode: only real rows need blocks (pad writes go
+            # to the trash block); blocking mode writes the whole
+            # left-padded bucket.
+            bs = self.backend.block_size
+            rows = len(prompt) + max_new_tokens if self.stall_free \
+                else max(bucket, len(prompt) + max_new_tokens)
+            # the +1 decode block caps at the slot row (a request
+            # spanning the whole row grows no further)
+            need = min(-(-rows // bs) + 1,
+                       -(-self.backend.max_len // bs))
+            total = self.backend.allocator.usable_blocks
+            if need > total:
+                self._reject(
+                    f"request needs {need} KV blocks (block_size {bs}); "
+                    f"the whole pool holds {total} — can never fit")
         deadline = None if timeout is None else time.time() + timeout
         with self._work:
             if self._stop_mode is not None or self._fatal is not None:
@@ -566,14 +723,26 @@ class GenerationEngine:
         if busy > self.stats["peak_slots_busy"]:
             self.stats["peak_slots_busy"] = busy
         self._metric("gauge", "serving_slots_busy", busy)
+        if self.paged:
+            self._export_pool_metrics()
         if not active:
             return worked
+        if self.paged:
+            # Lazy decode growth: every RUNNING slot needs a writable
+            # block at its frontier before it may step; a slot the pool
+            # cannot serve sits this iteration out (backpressure, not a
+            # crash), and if NOBODY can step the newest request is
+            # preempted to break the deadlock.
+            active = self._filter_block_stalled(active)
+            if not active:
+                return True
         toks = self._step_with_isolation()
         if toks is not None:
             self.stats["steps"] += 1
             for slot, req in active:
                 if req.state == RUNNING:  # not evicted mid-isolation
                     self._deliver(req, int(toks[slot]))
+                    req.write_pos += 1
         return True
 
     def run_until_idle(self):
@@ -654,14 +823,57 @@ class GenerationEngine:
                     self._thread = None
 
     # -- refill -----------------------------------------------------------
+    def _served_prompt(self, req: Request) -> list:
+        """The token sequence this admission actually prefills: the
+        prompt, plus any tokens already generated before a preemption
+        (the recompute-resume — greedy K/V is deterministic, so the
+        continuation picks up exactly where the preempted decode
+        left off)."""
+        return req.prompt + req.tokens if req.tokens else req.prompt
+
+    def _blocks_needed(self, req: Request) -> int:
+        """Worst-case NEW blocks an admission must be able to cover:
+        the REAL prompt rows (chunk-pad writes route to the trash
+        block, so alignment never inflates the footprint — in
+        particular after a preemption resume) — or the blocking
+        bucket, whose left-pad rows ARE written — plus one decode
+        block. Radix grafts only reduce the real allocation, never the
+        gate (conservative)."""
+        served = len(self._served_prompt(req))
+        rows = served if self.stall_free else \
+            self._blocking_bucket(served, req)
+        rows = min(rows, self.backend.max_len)
+        bs = self.backend.block_size
+        return min(-(-rows // bs) + 1,
+                   -(-self.backend.max_len // bs))
+
+    def _blocking_bucket(self, served: int, req: Request) -> int:
+        """Blocking-path bucket for (possibly resumed) ``served``
+        tokens: the power-of-two bucket, clamped so bucket + the
+        remaining output still fits the slot row — a resume whose
+        re-bucket overshoots ``max_len`` must degrade to a snug
+        non-power-of-two bucket (one extra compiled prefill per resume
+        length; preemption is rare), never quarantine. Always >=
+        ``served``: admission guaranteed served + remaining <=
+        max_len."""
+        remaining = max(1, req.max_new_tokens - len(req.tokens))
+        return min(bucket_length(served, self.min_bucket),
+                   self.backend.max_len - remaining)
+
     def _pop_to_slot(self):
         """Move the queue head into the lowest free slot (admission
         bookkeeping shared by both scheduler modes); returns
         ``(req, slot)`` or ``(None, None)`` when there is nothing to
-        do."""
+        do. Paged mode additionally gates on KV-pool capacity: a head
+        the pool cannot cover WAITS (FIFO — later smaller requests do
+        not jump it), counted in ``admission_block_waits``."""
         with self._work:
             free = [s for s, r in enumerate(self._slots) if r is None]
             if not free or not self._queue:
+                return None, None
+            if self.paged and not self.backend.can_reserve(
+                    self._blocks_needed(self._queue[0])):
+                self.stats["admission_block_waits"] += 1
                 return None, None
             req = self._queue.popleft()
             slot = min(free)  # deterministic: lowest free slot, FIFO
@@ -685,8 +897,17 @@ class GenerationEngine:
             req, slot = self._pop_to_slot()
             if req is None:
                 break
+            try:
+                ok = self._prefill_with_retries(req, slot)
+            except BlockExhausted:
+                # The admission gate was optimistic (an imminent graft
+                # can pin blocks it counted evictable): requeue at the
+                # FRONT and wait — exhaustion is backpressure, never a
+                # quarantine.
+                self._requeue_for_blocks(req, slot)
+                break
             admitted += 1
-            if not self._prefill_with_retries(req, slot):
+            if not ok:
                 with self._work:
                     self._slots[slot] = None
                     self._work.notify_all()
@@ -695,6 +916,17 @@ class GenerationEngine:
                 # slot's fill state on the blocking path either.
                 self._release_slot(slot)
         return admitted
+
+    def _requeue_for_blocks(self, req: Request, slot: int):
+        with self._work:
+            if self._slots[slot] is req:
+                self._slots[slot] = None
+            self._queue.appendleft(req)
+            self._work.notify_all()
+        self._release_slot(slot)
+        req.slot = None
+        self.stats["admission_block_waits"] += 1
+        events.event("serve_admission_block_wait", request=req.id)
 
     # -- stall-free admission + chunked prefill ---------------------------
     def _admit(self) -> int:
@@ -706,12 +938,14 @@ class GenerationEngine:
             req, slot = self._pop_to_slot()
             if req is None:
                 break
+            if not self._arm_chunked_prefill(req, slot):
+                break  # requeued on block exhaustion: wait, FIFO order
             admitted += 1
-            self._arm_chunked_prefill(req, slot)
         return admitted
 
-    def _arm_chunked_prefill(self, req: Request, slot: int):
+    def _arm_chunked_prefill(self, req: Request, slot: int) -> bool:
         c = self.prefill_chunk
+        served = self._served_prompt(req)
         with self._lock:
             n_running = sum(1 for r in self._slots
                             if r is not None and r.state == RUNNING)
@@ -721,16 +955,40 @@ class GenerationEngine:
             # Under the same watchdog + stall ledger as every other
             # device call: a prefix-cache hit scatters K/V rows
             # device-side, which both stalls running decodes and can
-            # wedge exactly like a chunk.
+            # wedge exactly like a chunk. (A paged backend's graft is a
+            # pointer swap — cheap, but the ledger stays honest.)
             start = int(self._timed(
-                lambda: self.backend.begin_prefill(slot, req.prompt, c),
+                lambda: self.backend.begin_prefill(slot, served, c),
                 "prefix_seed"))
         except ServingStallError:
             raise  # a wedged device is never a per-request fault
+        except BlockExhausted:
+            # Optimistic-gate miss (see _refill): requeue and wait.
+            self._requeue_for_blocks(req, slot)
+            return False
         except Exception as e:  # noqa: BLE001 — reuse is an optimization
             if getattr(e, "serving_fatal", False):
                 self._handle_fatal(e)
                 raise
+            if self.paged:
+                # Paged begin_prefill is RESERVATION, not just reuse: a
+                # cold fallback would chunk-write through an unreserved
+                # (trash-parked) table — silently wrong tokens. Retry
+                # the whole admission; quarantine past the budget.
+                req.failures += 1
+                if req.failures > self.retries:
+                    with self._work:
+                        if self._slots[slot] is req:
+                            self._slots[slot] = None
+                        self._work.notify_all()
+                    self._release_slot(slot)
+                    self._quarantine(req, e)
+                    return True  # slot freed — keep admitting others
+                events.event("serve_reserve_retry", request=req.id,
+                             attempt=req.failures,
+                             error=f"{type(e).__name__}: {e}"[:200])
+                self._requeue_for_blocks(req, slot)
+                return False
             events.event("serve_prefix_seed_failed", request=req.id,
                          error=f"{type(e).__name__}: {e}"[:200])
             start = 0
@@ -742,13 +1000,13 @@ class GenerationEngine:
         # misaligned plan (a non-chunk-multiple start could make the
         # final chunk's scatter clamp at max_len and slide back over
         # committed rows).
-        if not 0 <= start < len(req.prompt) or start % c:
+        if not 0 <= start < len(served) or start % c:
             if start != 0:
                 log.warning("backend.begin_prefill returned offset %s "
                             "for a %s-token prompt (chunk %s); ignoring "
-                            "prefix reuse", start, len(req.prompt), c)
+                            "prefix reuse", start, len(served), c)
             start = 0
-        tail = req.prompt[start:]
+        tail = served[start:]
         plan = []
         for i in range(0, len(tail), c):
             part = list(tail[i:i + c])
@@ -760,24 +1018,53 @@ class GenerationEngine:
         req.chunk_base = start
         req.next_chunk = 0
         req.prefill_reused = start
+        req.served_len = len(served)
         req.state = PREFILLING
+        return True
 
     def _prefill_tick(self) -> bool:
-        """Advance the OLDEST-admitted PREFILLING slot by exactly one
-        chunk (the per-iteration prefill token budget is the chunk
-        size): every other slot's decode step runs in the same
-        iteration, so a long prompt costs each running request one
-        chunk of extra latency per step, never a whole O(L²) prefill.
+        """Spend this iteration's prefill TOKEN budget
+        (``SPARKDL_SERVE_PREFILL_BUDGET``, default one chunk — the
+        exact PR 9 pacing) one chunk at a time, round-robin oldest-
+        admitted-first across every PREFILLING slot: with the default
+        budget exactly one chunk of the oldest request runs per
+        iteration; with a larger budget one iteration can advance —
+        and complete — several refills, removing the ~1
+        admission/iteration cap that starved high-churn mixes. Every
+        RUNNING slot's decode still runs in the same iteration, so a
+        long prompt costs running requests at most ``budget`` tokens of
+        added latency per step, never a whole O(L²) prefill.
         Chunk-aware retry: a failed chunk stays current (the cache
         holds every committed chunk) and is re-attempted next tick;
         past the retry budget the REQUEST is quarantined and its slot
         freed — the gang keeps serving."""
-        with self._lock:
-            prefilling = [r for r in self._slots
-                          if r is not None and r.state == PREFILLING]
+        budget = self.prefill_budget
+        worked = False
+        while budget > 0:
+            with self._lock:
+                prefilling = sorted(
+                    (r for r in self._slots
+                     if r is not None and r.state == PREFILLING),
+                    key=lambda r: (r.t_admit or 0.0, r.id))
             if not prefilling:
-                return False
-            req = min(prefilling, key=lambda r: (r.t_admit or 0.0, r.id))
+                break
+            progressed = False
+            for req in prefilling:
+                if budget <= 0:
+                    break
+                if req.state != PREFILLING:
+                    continue
+                self._prefill_chunk_once(req)
+                progressed = worked = True
+                budget -= self.prefill_chunk
+            if not progressed:
+                break
+        return worked
+
+    def _prefill_chunk_once(self, req: Request) -> None:
+        """Run exactly one chunk (or the final chunk + finish) of one
+        PREFILLING request — the unit the budget loop spends."""
+        with self._lock:
             n_running = sum(1 for r in self._slots
                             if r is not None and r.state == RUNNING)
         c = self.prefill_chunk
@@ -797,11 +1084,15 @@ class GenerationEngine:
                 # Commit policy: caching a one-chunk prompt can never
                 # save a chunk on reuse, and a prompt the cache already
                 # mostly served (a warm hit's distinct tail) adds no
-                # reusable head — skip the commit copy for both.
-                commit = aligned > c and req.prefill_reused * 2 < aligned
+                # reusable head — skip the commit copy for both. A
+                # paged backend's radix commit is a zero-copy pointer
+                # insert, so there is no copy economy to police:
+                # commit whenever the prompt holds a full block.
+                commit = True if self.paged else (
+                    aligned > c and req.prefill_reused * 2 < aligned)
                 tok = self._timed(
                     lambda: self.backend.finish_prefill(
-                        req.slot, req.prompt, tok, aligned,
+                        req.slot, self._served_prompt(req), tok, aligned,
                         commit=commit),
                     "finish_prefill")
         except ServingStallError:
@@ -826,7 +1117,7 @@ class GenerationEngine:
                              chunk=req.next_chunk, offset=offset,
                              attempt=req.failures,
                              error=f"{type(e).__name__}: {e}"[:200])
-            return True
+            return
         dt = time.perf_counter() - t0
         self._note_stall(dt, n_running)
         req.prefill_spent_s += dt
@@ -839,18 +1130,21 @@ class GenerationEngine:
                 # while the chunk was in flight: the request was already
                 # reported failed — never resurrect it to RUNNING or
                 # stream a token after the failure.
-                return True
+                return
             req.state = RUNNING
+            req.write_pos = req.served_len  # decode writes from L
             req.t_decode_start = time.time()
             events.completed_span(
                 "serve_prefill", req.prefill_spent_s, request=req.id,
                 slot=req.slot, bucket=req.bucket, rows=1,
                 chunks=len(req.chunk_plan), reused=req.prefill_reused)
             self._deliver(req, int(tok))
-        return True
 
     def _prefill_with_retries(self, req: Request, slot: int) -> bool:
         last: BaseException | None = None
+        served = self._served_prompt(req)
+        if req.tokens:  # preemption resume: re-bucket the longer prompt
+            req.bucket = self._blocking_bucket(len(served), req)
         for attempt in range(self.retries + 1):
             with self._lock:
                 n_running = sum(1 for r in self._slots
@@ -860,7 +1154,7 @@ class GenerationEngine:
                 with events.span("serve_prefill", request=req.id, slot=slot,
                                  bucket=req.bucket, rows=1):
                     first = self._timed(
-                        lambda: self.backend.prefill(slot, req.prompt,
+                        lambda: self.backend.prefill(slot, served,
                                                      req.bucket),
                         "prefill")
                 # The head-of-line stall this whole prefill inflicted on
@@ -875,11 +1169,15 @@ class GenerationEngine:
                     # RUNNING or stream a token after the failure.
                     return False
                 req.state = RUNNING
+                req.served_len = len(served)
+                req.write_pos = req.bucket  # blocking layout: cur=bucket
                 req.t_decode_start = time.time()
                 self._deliver(req, int(first))
                 return True
             except ServingStallError:
                 raise  # a wedged device is never a per-request fault
+            except BlockExhausted:
+                raise  # capacity, not a fault: _refill requeues + waits
             except Exception as e:  # noqa: BLE001 — per-request isolation
                 if getattr(e, "serving_fatal", False):
                     # e.g. backend.SlotCacheLost: the donated cache was
@@ -928,7 +1226,8 @@ class GenerationEngine:
         while True:
             with self._lock:
                 slots = sorted(s for s, r in enumerate(self._slots)
-                               if r is not None and r.state == RUNNING)
+                               if r is not None and r.state == RUNNING
+                               and not r._block_stalled)
             if not slots:
                 # Every running request was evicted (each already
                 # quarantined with its cause): the engine stays alive
@@ -955,7 +1254,8 @@ class GenerationEngine:
                     continue
                 with self._lock:
                     running = [r for r in self._slots
-                               if r is not None and r.state == RUNNING]
+                               if r is not None and r.state == RUNNING
+                               and not r._block_stalled]
                     victim = max(running, key=lambda r: r.t_admit or 0.0) \
                         if running else None
                     if victim is not None:
@@ -967,6 +1267,78 @@ class GenerationEngine:
                     self._release_slot(victim.slot)
                     self._quarantine(victim, e)
                 attempts = 0
+
+    # -- paged-mode block growth / backpressure ---------------------------
+    def _filter_block_stalled(self, active):
+        """Secure a writable frontier block for every RUNNING slot
+        (oldest admitted first — FIFO priority when blocks are scarce).
+        Slots the pool cannot serve are flagged ``_block_stalled`` and
+        sit the decode step out; if EVERY running slot stalls, the
+        newest-admitted one is preempted (released + requeued for a
+        recompute resume) so the others can make progress — exhaustion
+        never evicts work, the worst case is a deferred request."""
+        ordered = sorted(active,
+                         key=lambda sr: (sr[1].t_admit or 0.0, sr[1].id))
+        ok, stalled = [], []
+        for slot, req in ordered:
+            req._block_stalled = False
+            if self.backend.ensure_block_for(slot, req.write_pos):
+                ok.append((slot, req))
+            else:
+                req._block_stalled = True
+                stalled.append((slot, req))
+                self.stats["block_stall_events"] += 1
+        if stalled and not ok:
+            victim = self._preempt_newest(stalled)
+            # the victim's blocks are free now: give the survivors one
+            # immediate retry instead of a wasted iteration
+            for slot, req in stalled:
+                if req is victim:
+                    continue
+                if self.backend.ensure_block_for(slot, req.write_pos):
+                    req._block_stalled = False
+                    ok.append((slot, req))
+        return sorted(ok)
+
+    def _preempt_newest(self, stalled) -> Request:
+        """Deadlock breaker: requeue (front, FIFO-fair) the NEWEST
+        stalled request. Its blocks free immediately; on re-admission
+        it prefills ``prompt + tokens-so-far`` and continues — greedy
+        output is unchanged (the recompute writes the identical K/V),
+        already-streamed tokens are never re-emitted."""
+        victim = max((r for _, r in stalled),
+                     key=lambda r: (r.t_admit or 0.0, r.id))
+        slot = victim.slot
+        with self._work:
+            if slot is not None and self._slots[slot] is victim:
+                self._slots[slot] = None
+            self._queue.appendleft(victim)
+            self._work.notify_all()
+        self._release_slot(slot)
+        victim.slot = None
+        victim.state = QUEUED
+        victim.chunk_plan = None
+        victim._block_stalled = False
+        victim.preemptions += 1
+        self.stats["preemptions"] += 1
+        events.event("serve_request_preempted", request=victim.id,
+                     generated=len(victim.tokens))
+        self._metric("counter", "serving_requests_preempted_total")
+        return victim
+
+    def _export_pool_metrics(self):
+        if not telemetry.enabled():
+            return
+        ps = self.backend.pool_stats()
+        self._metric("gauge", "serving_kv_blocks_free",
+                     ps.get("blocks_free", 0))
+        self._metric("gauge", "serving_kv_blocks_shared",
+                     ps.get("blocks_shared", 0))
+        drain = getattr(self.backend, "drain_alloc_samples", None)
+        if drain is not None:
+            for dt in drain():
+                self._metric("histogram", "serving_block_alloc_s", dt,
+                             buckets=_ALLOC_BUCKETS)
 
     def _deliver(self, req: Request, tok: int):
         req.tokens.append(tok)
@@ -1078,6 +1450,8 @@ class GenerationEngine:
                 "num_slots": len(self._slots),
                 "stall_free": self.stall_free,
                 "prefill_chunk": self.prefill_chunk,
+                "prefill_budget": self.prefill_budget,
+                "paged": self.paged,
                 **dict(self.stats),
             }
         ps = getattr(self.backend, "prefix_stats", None)
@@ -1085,4 +1459,8 @@ class GenerationEngine:
             st = ps()
             if st:
                 snap["prefix_cache"] = st
+        if self.paged:
+            pool = getattr(self.backend, "pool_stats", None)
+            if callable(pool):
+                snap["kv_pool"] = pool()
         return snap

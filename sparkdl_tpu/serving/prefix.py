@@ -1,4 +1,4 @@
-"""Shared-prefix KV reuse — the jax-free LRU bookkeeping (ISSUE 10).
+"""Shared-prefix KV reuse — the jax-free bookkeeping (ISSUE 10 + 11).
 
 A serving fleet sees the same prompt *heads* over and over (system
 prompts, few-shot preambles, retry storms of one request). Prefilling
@@ -25,16 +25,33 @@ Invalidation is purely budget-driven (LRU under
 ``SPARKDL_SERVE_PREFIX_CACHE_MB``): entries are immutable snapshots of
 prompt-derived K/V, so they can never go stale — only cold. A backend
 that swaps weights must ``clear()`` (new params ⇒ new K/V).
+
+**Radix sharing (ISSUE 11, paged backends).** :class:`PrefixCache`
+copies K/V rows slot↔entry on every commit and hit. With a paged
+backend the prompt's K/V already lives in shared-pool *blocks*, so
+:class:`RadixPrefixCache` stores no payloads at all: it is a trie keyed
+on **block-sized token runs** whose nodes name *physical block ids*. A
+commit inserts the prompt's full blocks (the trie takes one refcount on
+each through the :class:`serving.paging.BlockAllocator`); a hit is a
+**block-table pointer graft** — the new slot's table entries point at
+the cached blocks (one more refcount each), zero bytes copied, so the
+system-prompt head of every concurrent request is ONE physical set of
+blocks. Eviction is LRU over leaf blocks nobody references (refcount
+1 = trie-only), driven by the allocator's ``reclaim`` hook when the
+free list runs short — cached prefixes are reclaimable capacity, never
+a leak. The same weight-swap rule applies: ``clear()`` on new params.
 """
 
 from __future__ import annotations
 
 import collections
+import itertools
 import os
 import threading
 
-__all__ = ["PrefixCache", "PREFIX_CACHE_MB_ENV", "DEFAULT_PREFIX_CACHE_MB",
-           "prefix_cache_budget_bytes", "usable_reuse"]
+__all__ = ["PrefixCache", "RadixPrefixCache", "PREFIX_CACHE_MB_ENV",
+           "DEFAULT_PREFIX_CACHE_MB", "prefix_cache_budget_bytes",
+           "usable_reuse"]
 
 PREFIX_CACHE_MB_ENV = "SPARKDL_SERVE_PREFIX_CACHE_MB"
 DEFAULT_PREFIX_CACHE_MB = 64.0
@@ -176,6 +193,187 @@ class PrefixCache:
                 "evictions": self.evictions,
                 "oversize": self.oversize,
                 "reused_tokens": self.reused_tokens,
+                "hit_rate": round(self.hits / (self.hits + self.misses), 4)
+                if (self.hits + self.misses) else None,
+            }
+
+
+class _RadixNode:
+    """One cached physical block: the trie edge into it is the block's
+    token run, ``block`` its pool id."""
+
+    __slots__ = ("children", "block", "last_used", "parent", "run")
+
+    def __init__(self, parent=None, run=None, block=None):
+        self.children: dict[tuple, _RadixNode] = {}
+        self.block = block
+        self.last_used = 0
+        self.parent = parent
+        self.run = run
+
+
+class RadixPrefixCache:
+    """Trie of block-sized token runs → physical pool block ids (see
+    module doc). Holds ONE allocator reference per cached block; a graft
+    is the caller's extra reference, eviction drops the trie's.
+
+    Thread-safe for the same reason :class:`PrefixCache` is: the
+    scheduler thread mutates while ``snapshot()`` callers read stats.
+    Only FULL blocks are cached (a partial tail block is private to its
+    request — its later positions get overwritten by that request's own
+    decode, so sharing it would alias live writes).
+    """
+
+    def __init__(self, allocator, block_size: int):
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.allocator = allocator
+        self.block_size = int(block_size)
+        self._root = _RadixNode()
+        self._lock = threading.Lock()
+        self._clock = itertools.count(1)
+        self._n_blocks = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.reused_tokens = 0
+        self.inserted_blocks = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return self._n_blocks
+
+    def _runs(self, prompt):
+        bs = self.block_size
+        prompt = tuple(prompt)
+        return [prompt[i:i + bs] for i in
+                range(0, (len(prompt) // bs) * bs, bs)]
+
+    def lookup(self, prompt) -> list[int]:
+        """Physical block ids of the longest cached chain of FULL
+        block runs heading ``prompt`` (possibly empty). Pure — counters
+        and LRU order move only on :meth:`use` / :meth:`note_miss`,
+        exactly the :class:`PrefixCache` contract."""
+        out: list[int] = []
+        with self._lock:
+            node = self._root
+            for run in self._runs(prompt):
+                node = node.children.get(run)
+                if node is None:
+                    break
+                out.append(node.block)
+        return out
+
+    def use(self, prompt, n_blocks: int, reused_tokens: int):
+        """Record one actual graft of ``n_blocks`` cached blocks (LRU
+        touch along the used chain + hit/reused-token counters). The
+        CALLER refs the grafted blocks through the allocator — the trie
+        only re-times them."""
+        with self._lock:
+            node, now = self._root, next(self._clock)
+            for run in self._runs(prompt)[:n_blocks]:
+                node = node.children.get(run)
+                if node is None:
+                    break
+                node.last_used = now
+            self.hits += 1
+            self.reused_tokens += int(reused_tokens)
+
+    def note_miss(self):
+        with self._lock:
+            self.misses += 1
+
+    def insert(self, prompt, block_ids) -> int:
+        """Cache ``prompt``'s full-block runs as ``block_ids`` (the
+        committing slot's physical blocks, in logical order). New nodes
+        take one allocator ref; runs already cached keep their EXISTING
+        block (the committer's duplicate stays slot-private — two
+        physical copies of one run never both enter the trie). Returns
+        the number of newly cached blocks."""
+        runs = self._runs(prompt)
+        added = 0
+        with self._lock:
+            node, now = self._root, next(self._clock)
+            for run, block in zip(runs, block_ids):
+                child = node.children.get(run)
+                if child is None:
+                    self.allocator.ref(block)
+                    child = _RadixNode(parent=node, run=run, block=block)
+                    node.children[run] = child
+                    self._n_blocks += 1
+                    self.inserted_blocks += 1
+                    added += 1
+                child.last_used = now
+                node = child
+        return added
+
+    def evictable_blocks(self) -> int:
+        """Blocks the trie could free right now (refcount 1 = nobody
+        but the trie holds them). Conservative capacity signal for the
+        admission gate: free list + this is what ``allocate(reclaim=)``
+        can ultimately deliver. One refcount snapshot per call — not
+        one lock round-trip per node."""
+        rc = self.allocator.snapshot_refcounts()
+        with self._lock:
+            return sum(1 for n in self._iter_nodes() if rc[n.block] == 1)
+
+    def _iter_nodes(self):
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            yield n
+            stack.extend(n.children.values())
+
+    def evict(self, n: int) -> int:
+        """Free up to ``n`` blocks, LRU LEAF first (an inner node's
+        children would dangle — and a grafted chain refs its whole
+        head, so ancestors are never less referenced than descendants).
+        Only trie-exclusive blocks (refcount 1) are candidates; blocks
+        a live slot still reads are untouchable. Returns blocks freed —
+        this is the allocator's ``reclaim`` hook. Each pass collects
+        and drains ALL current leaf candidates in LRU order (one trie
+        scan per pass, not per block); further passes only run when an
+        eviction exposed a parent as a new leaf."""
+        freed = 0
+        with self._lock:
+            while freed < n:
+                rc = self.allocator.snapshot_refcounts()
+                victims = sorted(
+                    (node for node in self._iter_nodes()
+                     if not node.children and rc[node.block] == 1),
+                    key=lambda x: x.last_used)
+                if not victims:
+                    break
+                for v in victims:
+                    if freed >= n:
+                        break
+                    del v.parent.children[v.run]
+                    self.allocator.deref(v.block)
+                    self._n_blocks -= 1
+                    self.evictions += 1
+                    freed += 1
+        return freed
+
+    def clear(self):
+        """Drop every trie-held reference (weight swap). Blocks live
+        slots still reference stay allocated until those slots
+        release."""
+        with self._lock:
+            for node in list(self._iter_nodes()):
+                self.allocator.deref(node.block)
+            self._root = _RadixNode()
+            self._n_blocks = 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "blocks": self._n_blocks,
+                "block_size": self.block_size,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "reused_tokens": self.reused_tokens,
+                "inserted_blocks": self.inserted_blocks,
                 "hit_rate": round(self.hits / (self.hits + self.misses), 4)
                 if (self.hits + self.misses) else None,
             }

@@ -1,0 +1,597 @@
+"""Paged KV cache (ISSUE 11): block-table serving memory.
+
+Three layers, leanest first: jax-free allocator/radix invariants (the
+acceptance pins — no double-free, refcounted copy-on-write after a
+radix graft, exhaustion backpressures admission without evicting
+RUNNING requests), jax-free paged-engine scheduling over the
+``StubBackend`` mirror (admission block gate, multi-chunk prefill
+budgets, preemption-resume, pointer-graft sharing), then ONE lean
+CPU-llama class proving greedy token identity through paging +
+multi-chunk budgets + radix grafts with zero decode re-traces, and the
+shared head resident as one physical block set.
+"""
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu.serving import (BlockAllocator, BlockError,
+                                 BlockExhausted, GenerationEngine,
+                                 PagedBlockManager, RadixPrefixCache,
+                                 RequestRejected, StubBackend)
+
+# ---------------------------------------------------------------------------
+# allocator invariants (jax-free)
+# ---------------------------------------------------------------------------
+
+
+class TestBlockAllocator:
+    def test_alloc_free_cycle_and_trash_pinned(self):
+        a = BlockAllocator(8)  # block 0 = trash, 7 usable
+        assert a.usable_blocks == 7 and a.free_count() == 7
+        got = a.allocate(3)
+        assert len(got) == 3 and 0 not in got  # trash never handed out
+        assert a.used_count() == 3
+        for b in got:
+            assert a.deref(b) == 0
+        assert a.free_count() == 7 and a.stats()["frees"] == 3
+
+    def test_double_free_and_bad_refs_raise(self):
+        a = BlockAllocator(4)
+        (b,) = a.allocate(1)
+        a.deref(b)
+        with pytest.raises(BlockError, match="double free"):
+            a.deref(b)
+        with pytest.raises(BlockError, match="trash"):
+            a.deref(0)
+        with pytest.raises(BlockError, match="unallocated"):
+            a.ref(b)  # freed — re-refing it would resurrect a dangler
+        with pytest.raises(BlockError, match="invalid"):
+            a.deref(99)
+
+    def test_refcounts_shared_and_stats(self):
+        a = BlockAllocator(6)
+        b1, b2 = a.allocate(2)
+        assert a.ref(b1) == 2 and a.is_shared(b1)
+        assert not a.is_shared(b2)
+        st = a.stats()
+        assert st["blocks_used"] == 2 and st["blocks_shared"] == 1
+        assert st["shared_frac"] == 0.5
+        assert st["peak_utilization"] == pytest.approx(2 / 5)
+        a.deref(b1)
+        assert not a.is_shared(b1) and a.used_count() == 2  # still held
+
+    def test_exhaustion_returns_none_and_reclaim_hook(self):
+        a = BlockAllocator(4)  # 3 usable
+        held = a.allocate(3)
+        assert a.allocate(1) is None
+        assert a.stats()["failed_allocs"] == 1
+        calls = []
+
+        def reclaim(k):
+            calls.append(k)
+            a.deref(held.pop())  # free one on demand
+            return 1
+
+        got = a.allocate(1, reclaim=reclaim)
+        assert len(got) == 1 and calls == [1]
+
+    def test_alloc_latency_ledger_drains(self):
+        a = BlockAllocator(4)
+        a.allocate(2)
+        samples = a.drain_alloc_samples()
+        assert len(samples) == 1 and samples[0] >= 0.0
+        assert a.drain_alloc_samples() == []
+
+
+# ---------------------------------------------------------------------------
+# radix trie (jax-free)
+# ---------------------------------------------------------------------------
+
+
+def _radix(pool=32, bs=4):
+    a = BlockAllocator(pool)
+    return a, RadixPrefixCache(a, bs)
+
+
+class TestRadixPrefixCache:
+    def test_insert_lookup_full_blocks_only(self):
+        a, r = _radix()
+        blocks = a.allocate(3)
+        prompt = list(range(10))  # 2 full blocks of 4; 2-token tail
+        assert r.insert(prompt, blocks) == 2  # tail block never cached
+        assert len(r) == 2
+        assert r.lookup(prompt) == blocks[:2]
+        assert r.lookup(list(range(8)) + [99]) == blocks[:2]  # head only
+        assert r.lookup([7, 7, 7, 7]) == []
+        # the trie holds one ref per cached block
+        assert a.refcount(blocks[0]) == 2 and a.refcount(blocks[2]) == 1
+
+    def test_duplicate_run_keeps_existing_block(self):
+        a, r = _radix()
+        first = a.allocate(1)
+        second = a.allocate(1)
+        r.insert([1, 2, 3, 4], first)
+        assert r.insert([1, 2, 3, 4], second) == 0  # run already cached
+        assert r.lookup([1, 2, 3, 4]) == first
+        assert a.refcount(second[0]) == 1  # committer keeps its copy
+
+    def test_evict_lru_leaf_first_and_only_unreferenced(self):
+        a, r = _radix()
+        chain = a.allocate(2)          # [1,2,3,4] -> [5,6,7,8]
+        other = a.allocate(1)          # [9,9,9,9]
+        r.insert([1, 2, 3, 4, 5, 6, 7, 8], chain)
+        r.insert([9, 9, 9, 9], other)
+        for b in chain + other:
+            a.deref(b)                 # committers release: trie-only now
+        r.use([9, 9, 9, 9], 1, 4)      # touch -> chain tail is LRU leaf
+        assert r.evict(1) == 1         # the chain LEAF [5..8], never the
+        assert r.lookup([1, 2, 3, 4]) == chain[:1]  # still-parented head
+        # a grafted (refcount 2) block is untouchable
+        a.ref(other[0])
+        assert r.evict(5) == 1  # only the chain head was evictable
+        assert r.lookup([9, 9, 9, 9]) == other
+        st = r.stats()
+        assert st["evictions"] == 2 and st["hits"] == 1
+
+    def test_clear_drops_trie_refs_only(self):
+        a, r = _radix()
+        blocks = a.allocate(1)
+        r.insert([1, 2, 3, 4], blocks)
+        r.clear()
+        assert len(r) == 0
+        assert a.refcount(blocks[0]) == 1  # committer's ref survives
+
+
+# ---------------------------------------------------------------------------
+# manager: reservation / CoW / release (jax-free)
+# ---------------------------------------------------------------------------
+
+
+class TestPagedBlockManager:
+    def test_reserve_graft_then_private_and_release(self):
+        m = PagedBlockManager(2, 64, 4, 16)
+        assert m.reserve_prompt(0, list(range(10)), chunk=4) == 0  # cold
+        assert len(m.slot_blocks[0]) == 4  # ceil(12/4)=3 prompt + 1
+        m.commit(0, list(range(10)))       # 2 full blocks cached
+        m.release(0)
+        assert m.allocator.used_count() == 2  # trie keeps the 2 cached
+        # warm: same head grafts 2 blocks (pointers, shared), tail private
+        reuse = m.reserve_prompt(1, list(range(10)), chunk=4)
+        assert reuse == 8
+        assert m.slot_blocks[1][:2] == m.radix.lookup(list(range(10)))
+        assert m.allocator.is_shared(m.slot_blocks[1][0])
+        m.release(1)
+        # release is idempotent (the block list empties), and the
+        # trie's refs survive: only its 2 cached blocks stay resident
+        m.release(1)
+        assert m.allocator.used_count() == 2
+
+    def test_reserve_rollback_on_exhaustion_leaks_nothing(self):
+        m = PagedBlockManager(2, 64, 4, 5)  # 4 usable blocks
+        with pytest.raises(BlockExhausted):
+            m.reserve_prompt(0, list(range(30)), chunk=4)  # needs 9
+        assert m.slot_blocks[0] == []
+        assert m.allocator.used_count() == 0  # full rollback
+        # and a graft that precedes the failed allocation rolls back too
+        m2 = PagedBlockManager(2, 64, 4, 6)  # 5 usable
+        m2.reserve_prompt(0, list(range(8)), chunk=4)   # 2+1 = 3 used
+        m2.commit(0, list(range(8)))
+        m2.release(0)                                   # trie keeps 2
+        with pytest.raises(BlockExhausted):
+            # grafts 2, then needs ceil(20/4)-2+1 = 4 privates; free = 3
+            m2.reserve_prompt(1, list(range(8)) + list(range(50, 62)),
+                              chunk=4)
+        assert m2.slot_blocks[1] == []
+        assert m2.allocator.used_count() == 2  # only the trie's blocks
+
+    def test_cow_on_shared_block_write(self):
+        copies = []
+        m = PagedBlockManager(2, 64, 4, 16,
+                              copy_block=lambda s, d: copies.append(
+                                  (s, d)))
+        m.reserve_prompt(0, list(range(8)), chunk=4)
+        m.commit(0, list(range(8)))
+        m.release(0)
+        m.reserve_prompt(1, list(range(8)), chunk=4)  # grafts block 0-1?
+        # reuse = usable_reuse(8, 8, 4) = 4 -> one grafted block
+        shared = m.slot_blocks[1][0]
+        assert m.allocator.is_shared(shared)
+        # a write into the shared block triggers copy-on-write: fresh
+        # private block, contents copied, old ref dropped — the OTHER
+        # holder (the trie) keeps reading the original
+        assert m.ensure_block_for(1, 0) is True
+        assert m.slot_blocks[1][0] != shared
+        assert copies == [(shared, m.slot_blocks[1][0])]
+        assert m.allocator.refcount(shared) == 1  # trie's ref only
+        assert m.allocator.stats()["cow_blocks"] == 1
+
+    def test_decode_growth_and_stall(self):
+        m = PagedBlockManager(1, 64, 4, 4)  # 3 usable
+        m.reserve_prompt(0, [1, 2, 3], chunk=4)  # 1 prompt + 1 decode
+        assert m.ensure_block_for(0, 7) is True   # within reservation
+        assert m.ensure_block_for(0, 8) is True   # growth: 3rd block
+        assert m.ensure_block_for(0, 12) is False  # pool dry: stall
+        assert m.ensure_block_for(0, 999) is False  # beyond the row
+
+
+# ---------------------------------------------------------------------------
+# paged engine scheduling over the stub mirror (jax-free)
+# ---------------------------------------------------------------------------
+
+
+def _paged_stub(slots=4, max_len=64, *, block_size=4, pool_blocks=80,
+                **kw):
+    return StubBackend(slots, max_len, vocab_size=100,
+                       block_size=block_size, pool_blocks=pool_blocks,
+                       **kw)
+
+
+class TestPagedStubEngine:
+    def test_token_identity_and_pool_stats(self):
+        def run(paged):
+            be = _paged_stub() if paged else StubBackend(4, 64,
+                                                         vocab_size=100)
+            eng = GenerationEngine(be, prefill_chunk=4)
+            rs = [eng.submit(list(range(b, b + 9)), max_new_tokens=5)
+                  for b in (1, 20, 40, 60, 1)]
+            eng.run_until_idle()
+            return [r.result(1) for r in rs], eng.snapshot()
+
+        toks_p, snap_p = run(True)
+        toks_l, snap_l = run(False)
+        assert toks_p == toks_l  # paging never changes the stream
+        pool = snap_p["kv_pool"]
+        assert snap_p["paged"] is True and pool["blocks_total"] == 79
+        assert pool["peak_utilization"] > 0
+        assert snap_p["prefix_cache"]["hits"] >= 1  # repeated prompt
+        assert "kv_pool" not in snap_l
+
+    def test_admission_gate_waits_never_evicts_running(self):
+        # pool covers ~one request at a time: the second WAITS (counted)
+        # and completes after the first retires — no quarantine, no
+        # preemption, no crash (the ISSUE 11 backpressure acceptance)
+        be = _paged_stub(slots=4, pool_blocks=9)  # 8 usable
+        eng = GenerationEngine(be, prefill_chunk=4)
+        rs = [eng.submit(list(range(1, 12)), max_new_tokens=4)
+              for _ in range(4)]  # each needs ceil(12/4)+1 = 4 blocks
+        eng.run_until_idle()
+        snap = eng.snapshot()
+        assert snap["completed"] == 4
+        assert snap["admission_block_waits"] > 0
+        assert snap["quarantined"] == 0 and snap["preemptions"] == 0
+        assert all(len(r.result(1)) == 4 for r in rs)
+        assert be.allocator.used_count() == len(be.mgr.radix or [])
+
+    def test_never_fits_rejected_at_the_door(self):
+        be = _paged_stub(slots=2, pool_blocks=5)  # 4 usable
+        eng = GenerationEngine(be, prefill_chunk=4)
+        with pytest.raises(RequestRejected, match="never fit"):
+            eng.submit(list(range(1, 13)), max_new_tokens=8)  # 6 blocks
+        assert eng.snapshot()["rejected"] == 1
+
+    def test_multi_chunk_budget_fills_multiple_slots_per_iteration(self):
+        def chunks_after_one_step(budget):
+            be = _paged_stub(slots=3, pool_blocks=80)
+            eng = GenerationEngine(be, prefill_chunk=4,
+                                   prefill_budget=budget)
+            a = eng.submit(list(range(1, 9)), max_new_tokens=1)
+            b = eng.submit(list(range(11, 19)), max_new_tokens=1)
+            eng.step()
+            n = eng.snapshot()["prefill_chunks"]
+            eng.run_until_idle()
+            assert a.result(1) and b.result(1)
+            return n
+
+        assert chunks_after_one_step(None) == 1   # PR 9 default pacing
+        assert chunks_after_one_step(8) == 2      # 2 slots, 1 iteration
+
+    def test_budget_drains_one_long_prompt_faster(self):
+        be = _paged_stub(slots=2, max_len=128, pool_blocks=80)
+        eng = GenerationEngine(be, prefill_chunk=4, prefill_budget=16)
+        r = eng.submit(list(range(1, 17)), max_new_tokens=1)  # 4 chunks
+        eng.step()
+        assert eng.snapshot()["prefill_chunks"] == 4  # one iteration
+        eng.run_until_idle()
+        assert r.result(1)
+
+    def test_preemption_breaks_total_stall_and_resumes(self):
+        # each request alone fits (5 blocks); two concurrently demand 8
+        # of 5 usable -> decode growth eventually stalls BOTH -> the
+        # newest is preempted (requeued, blocks freed), the oldest
+        # finishes, the victim resumes and completes its full length
+        be = _paged_stub(slots=2, pool_blocks=6,
+                         prefix_cache_bytes=0)  # 5 usable
+        eng = GenerationEngine(be, prefill_chunk=4)
+        a = eng.submit([1, 2, 3, 4], max_new_tokens=12)
+        b = eng.submit([5, 6, 7, 8], max_new_tokens=12)
+        eng.run_until_idle()
+        snap = eng.snapshot()
+        assert snap["completed"] == 2
+        assert snap["preemptions"] >= 1
+        assert snap["block_stall_events"] >= 1
+        assert snap["quarantined"] == 0
+        assert len(a.result(1)) == 12 and len(b.result(1)) == 12
+        assert b.preemptions + a.preemptions == snap["preemptions"]
+        assert be.allocator.used_count() == 0  # every block came home
+
+    def test_resume_with_chunk_pad_past_max_len_is_clamped(self):
+        """A preemption resume prefills prompt + generated tokens; when
+        the chunk size does not divide max_len, the chunk-aligned
+        served length can pad PAST the slot row (submit only aligned
+        the original prompt). The reservation must clamp to max_blocks
+        instead of overflowing the table, and the resumed request must
+        still complete its full length."""
+        be = _paged_stub(slots=2, max_len=20, block_size=4,
+                         pool_blocks=12, prefix_cache_bytes=0)
+        eng = GenerationEngine(be, prefill_chunk=8)
+        r = eng.submit(list(range(1, 11)), max_new_tokens=10)  # L+new=20
+        for _ in range(8):  # 2 prefill iterations + 8 tokens
+            eng.step()
+        assert r.state == "running" and len(r.tokens) >= 8
+        # force the corner directly: preempt, then resume — served is
+        # now 18+ tokens, chunk-aligned 24 > max_len 20
+        eng._preempt_newest([(r.slot, r)])
+        assert r.state == "queued"
+        eng.run_until_idle()
+        assert len(r.result(1)) == 10
+        assert eng.snapshot()["preemptions"] == 1
+        assert be.allocator.used_count() == 0
+
+    def test_resume_need_never_exceeds_submit_gate(self):
+        """Review finding: chunk-aligning the resumed served prompt
+        could inflate _blocks_needed past what submit gated (chunk 16,
+        max_len 32, 7-usable pool: resume aligned to 32 -> 9 blocks),
+        livelocking the queue head forever. Real rows only (pad writes
+        go to the trash block): the resumed request must re-admit and
+        finish."""
+        be = _paged_stub(slots=2, max_len=32, block_size=4,
+                         pool_blocks=8, prefix_cache_bytes=0)
+        eng = GenerationEngine(be, prefill_chunk=16)
+        r = eng.submit(list(range(1, 17)), max_new_tokens=8)
+        for _ in range(3):
+            eng.step()
+        assert r.state == "running" and len(r.tokens) >= 1
+        eng._preempt_newest([(r.slot, r)])  # served is 17+ tokens now
+        eng.run_until_idle()
+        assert len(r.result(1)) == 8
+        assert eng.snapshot()["preemptions"] == 1
+        assert be.allocator.used_count() == 0
+
+    def test_blocking_resume_rebucket_clamps_to_max_len(self):
+        """Review finding: a blocking-mode resume re-bucketed with
+        bucket_length(served) (64 for 33 tokens), exceeding a
+        non-power-of-two max_len (48) and quarantining a healthy
+        request. The bucket must clamp to max_len - remaining and the
+        request must complete its full length."""
+        be = _paged_stub(slots=2, max_len=48, block_size=16,
+                         pool_blocks=16, prefix_cache_bytes=0)
+        eng = GenerationEngine(be, stall_free=False, min_bucket=16)
+        r = eng.submit(list(range(1, 31)), max_new_tokens=4)  # bucket 32
+        eng.step()
+        assert r.state == "running"
+        eng._preempt_newest([(r.slot, r)])  # served 31+ -> bucket_length 64
+        eng.run_until_idle()
+        assert len(r.result(1)) == 4  # completed, NOT quarantined
+        assert eng.snapshot()["quarantined"] == 0
+        assert be.allocator.used_count() == 0
+
+    def test_shared_head_is_pointer_graft_not_copy(self):
+        be = _paged_stub(slots=2, max_len=64, pool_blocks=40)
+        eng = GenerationEngine(be, prefill_chunk=4)
+        head = list(range(1, 9))  # 2 full blocks
+        h1 = eng.submit(head + [70, 71], max_new_tokens=2)
+        eng.run_until_idle()
+        allocs_cold = be.allocator.stats()["allocs"]
+        h2 = eng.submit(head + [80, 81, 82], max_new_tokens=2)
+        eng.run_until_idle()
+        assert h1.result(1) and h2.result(1)
+        st = be.mgr.prefix_stats()
+        assert st["hits"] == 1 and st["reused_tokens"] == 8
+        # the graft allocated only the TAIL's blocks (2: tail + decode),
+        # not the head's
+        assert be.allocator.stats()["allocs"] - allocs_cold <= 2
+
+    def test_blocking_mode_pages_too(self):
+        """SPARKDL_SERVE_STALL_FREE=0 on a paged backend still pages:
+        bucketed whole-prompt refills reserve bucket + 1 blocks, the
+        stream matches the legacy engine, and release returns every
+        block."""
+        def run(paged):
+            be = _paged_stub(slots=2, pool_blocks=40) if paged else \
+                StubBackend(2, 64, vocab_size=100)
+            eng = GenerationEngine(be, stall_free=False, min_bucket=8)
+            rs = [eng.submit(list(range(b, b + 5)), max_new_tokens=3)
+                  for b in (1, 30, 60)]
+            eng.run_until_idle()
+            return [r.result(1) for r in rs], be
+
+        toks_p, be = run(True)
+        toks_l, _ = run(False)
+        assert toks_p == toks_l
+        assert be.allocator.used_count() == 0  # all released
+
+    def test_pool_gauges_and_alloc_histogram_reach_telemetry(self):
+        from sparkdl_tpu.runner import telemetry
+        telemetry.reset()
+        telemetry.start()
+        try:
+            eng = GenerationEngine(_paged_stub(), prefill_chunk=4)
+            eng.submit(list(range(1, 9)), max_new_tokens=3)
+            eng.run_until_idle()
+            snap = telemetry.registry().snapshot()
+            assert "serving_kv_blocks_free" in snap["gauges"]
+            assert "serving_kv_blocks_shared" in snap["gauges"]
+            assert snap["histograms"]["serving_block_alloc_s"][
+                "count"] >= 1
+        finally:
+            telemetry.reset()
+
+    def test_engine_registers_nothing_when_plane_off(self):
+        from sparkdl_tpu.runner import telemetry
+        telemetry.reset()
+        eng = GenerationEngine(_paged_stub(), prefill_chunk=4)
+        eng.submit([1, 2], max_new_tokens=2)
+        eng.run_until_idle()
+        assert telemetry.registry().snapshot() == {
+            "counters": {}, "gauges": {}, "histograms": {}}
+
+    def test_bottleneck_report_surfaces_pool_gauges(self, tmp_path,
+                                                    capsys):
+        """An HBM-bound engine must be attributable from the report:
+        the gang-aggregated pool gauges print next to the stage table
+        (in-process main(), per the tier-1 lean rule)."""
+        import importlib.util
+        import json
+        import os
+        snap = {"t": 1.0, "rank": 0, "elapsed_s": 1.0, "stages": {},
+                "gauges": {"serving_kv_blocks_free":
+                           {"value": 3.0, "max": 64.0},
+                           "serving_kv_blocks_shared":
+                           {"value": 12.0, "max": 17.0}}}
+        (tmp_path / "metrics_rank0.json").write_text(json.dumps(snap))
+        spec = importlib.util.spec_from_file_location(
+            "bottleneck_report",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "scripts",
+                "bottleneck_report.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        rc = mod.main([str(tmp_path / "no-events"), "--metrics-dir",
+                       str(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "serving_kv_blocks_free: 3" in out
+        assert "high-water 17" in out
+
+
+# ---------------------------------------------------------------------------
+# paged engine on CPU over the tiny model (lean: one compile set)
+# ---------------------------------------------------------------------------
+
+
+class TestPagedEngineOnCpu:
+    def test_resume_pad_past_table_never_clobbers_committed_rows(self):
+        """Review finding: a resume whose chunk plan pads past the
+        block table used to CLAMP the out-of-range scatter onto the
+        last live block, overwriting the served prompt's committed K/V
+        (chunk 16, max_len 24, served 18 -> pad positions 24..31
+        landed on rows 16..23). Pad writes must route to the trash
+        block: the resumed request's greedy output stays bit-identical
+        to static generate()."""
+        import jax
+
+        from sparkdl_tpu.models import llama as L
+
+        cfg = L.LlamaConfig.tiny()
+        model = L.LlamaModel(cfg)
+        variables = model.init(jax.random.PRNGKey(0),
+                               np.zeros((1, 4), np.int32))
+        rng = np.random.RandomState(13)
+        prompt = rng.randint(0, cfg.vocab_size, 16).tolist()
+        ids, lens = L.left_pad_prompts([prompt])
+        ref = np.asarray(L.generate(model, variables, np.asarray(ids), 8,
+                                    pad_lens=np.asarray(lens),
+                                    pad_to=24))[0][16:].tolist()
+        eng = GenerationEngine.from_model(
+            model, variables, num_slots=1, max_len=24, block_size=8,
+            prefill_chunk=16, prefix_cache_mb=0)
+        r = eng.submit(prompt, max_new_tokens=8)
+        eng.step()  # chunk 1
+        eng.step()  # finish + first tokens
+        assert r.state == "running" and len(r.tokens) >= 2
+        eng._preempt_newest([(r.slot, r)])  # served 18 -> aligned 32 > 24
+        eng.run_until_idle()
+        assert r.result(1) == ref
+        assert eng.snapshot()["preemptions"] == 1
+
+    def test_paged_token_identity_radix_graft_and_cow(self):
+        """Paged llama engine with a multi-chunk budget: mixed 1/2/3-
+        chunk prompts must emit exactly the static generate() greedy
+        tokens; a shared head must be ONE physical block set across two
+        concurrently RUNNING slots (pointer graft); a forced write into
+        the shared block must copy-on-write with bit-identical content;
+        and the decode step must never re-trace through any of it."""
+        import jax
+
+        from sparkdl_tpu.core.runtime import GLOBAL_COMPILE_CACHE
+        from sparkdl_tpu.models import llama as L
+
+        cfg = L.LlamaConfig.tiny()
+        model = L.LlamaModel(cfg)
+        variables = model.init(jax.random.PRNGKey(0),
+                               np.zeros((1, 4), np.int32))
+        rng = np.random.RandomState(7)
+        max_len, new = 64, 6
+
+        # every reference stream from ONE batched generate() call (one
+        # prefill + one decode compile — the tier-1 lean rule)
+        prompts = [rng.randint(0, cfg.vocab_size, n).tolist()
+                   for n in (5, 17)]  # 1-chunk and 3-chunk
+        head = rng.randint(0, cfg.vocab_size, 16).tolist()
+        pa = head + rng.randint(0, cfg.vocab_size, 3).tolist()
+        pb = head + rng.randint(0, cfg.vocab_size, 6).tolist()
+        everything = prompts + [pa, pb]
+        ids, lens = L.left_pad_prompts(everything)
+        out = np.asarray(L.generate(model, variables, np.asarray(ids),
+                                    new, pad_lens=np.asarray(lens),
+                                    pad_to=max_len))
+        refs = [out[i][int(lens[i]) + len(p):].tolist()
+                for i, p in enumerate(everything)]
+
+        eng = GenerationEngine.from_model(
+            model, variables, num_slots=2, max_len=max_len,
+            prefill_chunk=8, block_size=8, prefill_budget=16)
+        assert eng.paged and eng.backend.paged
+        handles = [eng.submit(p, max_new_tokens=new) for p in prompts]
+        eng.run_until_idle()
+        assert eng.snapshot()["peak_slots_busy"] == 2
+        for p, h, want in zip(prompts, handles, refs):
+            assert h.result(1) == want, len(p)
+        sig_decode = GLOBAL_COMPILE_CACHE.signatures("serve_decode_step")
+
+        # shared 16-token head = 2 full blocks; pa commits, stays
+        # RUNNING while pb grafts the SAME physical blocks — one
+        # resident copy, the tables prove it
+        ha = eng.submit(pa, max_new_tokens=new)
+        eng.step()  # 2 of pa's 3 chunks (budget 16)
+        eng.step()  # final chunk + finish + first decode token
+        assert ha.state == "running"
+        hb = eng.submit(pb, max_new_tokens=new)
+        eng.step()  # admits + grafts + tail chunk
+        be = eng.backend
+        sa, sb = ha.slot, hb.slot
+        assert (be.tables[sa][:2] == be.tables[sb][:2]).all()
+        shared = int(be.tables[sb][0])
+        assert be.allocator.is_shared(shared)
+        util = be.pool_stats()
+        assert util["blocks_shared"] >= 2 and util["shared_frac"] > 0
+
+        # forced divergent write into the shared block: copy-on-write
+        # duplicates it bit-identically; the other holder keeps reading
+        # the original
+        assert be.mgr._cow(sb, 0) is True
+        fresh = int(be.tables[sb][0])
+        assert fresh != shared
+        for leaf in jax.tree_util.tree_leaves(be.cache):
+            if getattr(leaf, "ndim", 0) == 4:
+                assert np.array_equal(np.asarray(leaf[shared]),
+                                      np.asarray(leaf[fresh]))
+        eng.run_until_idle()
+        # identity survives the graft AND the CoW
+        assert ha.result(1) == refs[2]
+        assert hb.result(1) == refs[3]
+        ps = eng.snapshot()["prefix_cache"]
+        assert ps["hits"] >= 1 and ps["reused_tokens"] >= 16
+        assert GLOBAL_COMPILE_CACHE.signatures(
+            "serve_decode_step") == sig_decode  # zero re-traces
+
+        # blocking fallback on the SAME paged pool layout: bucketed
+        # left-padded whole-prompt refill through the block table
+        # (paged_prefill_into_slot) stays token-identical too
+        eng_bl = GenerationEngine.from_model(
+            model, variables, num_slots=2, max_len=max_len,
+            block_size=8, stall_free=False, min_bucket=8)
+        hb2 = eng_bl.submit(prompts[0], max_new_tokens=new)
+        eng_bl.run_until_idle()
+        assert hb2.result(1) == refs[0]
+        assert eng_bl.backend.allocator.used_count() == 0

@@ -695,6 +695,41 @@ class TestServingTelemetry:
 # ---------------------------------------------------------------------------
 
 class TestEngineOnCpu:
+    def test_token_identical_fast_twin(self):
+        """Lean twin of the slow staggered-refill test (ISSUE 11 tier-1
+        buy-back): TWO same-bucket prompts through a 2-slot blocking
+        engine — one prefill program, one decode program, one static
+        reference compile — pinning the engine-vs-generate() identity
+        contract in a fraction of the wall time. The 4-prompt
+        mixed-bucket + EOS variant runs behind ``slow``."""
+        import jax
+
+        from sparkdl_tpu.models import llama as L
+
+        cfg = L.LlamaConfig.tiny()
+        model = L.LlamaModel(cfg)
+        variables = model.init(jax.random.PRNGKey(0),
+                               np.zeros((1, 4), np.int32))
+        rng = np.random.RandomState(3)
+        max_len = 32
+        prompts = [rng.randint(0, cfg.vocab_size, n).tolist()
+                   for n in (5, 7)]  # one bucket (8)
+        ids, lens = L.left_pad_prompts(prompts, pad_to=8)
+        out = np.asarray(L.generate(model, variables, np.asarray(ids), 4,
+                                    pad_lens=np.asarray(lens),
+                                    pad_to=max_len))
+        refs = [out[i][int(lens[i]) + len(p):].tolist()
+                for i, p in enumerate(prompts)]
+        eng = GenerationEngine.from_model(
+            model, variables, num_slots=2, max_len=max_len, min_bucket=8,
+            stall_free=False)
+        handles = [eng.submit(p, max_new_tokens=4) for p in prompts]
+        eng.run_until_idle()
+        assert eng.snapshot()["peak_slots_busy"] == 2
+        for h, want in zip(handles, refs):
+            assert h.result(1) == want
+
+    @pytest.mark.slow
     def test_token_identical_with_staggered_refill_and_eos(self):
         """Mixed-length requests through a 2-slot engine emit exactly
         the static generate() greedy tokens — including a request
