@@ -1153,6 +1153,16 @@ def _serve_headline(serve: dict) -> dict:
                      ("preemptions", "serve_preemptions")):
         if churn.get(src) is not None:
             out[dst] = churn[src]
+    # ISSUE 12: speculative-decoding headline — single-stream tokens/s
+    # over the k=0 engine on the high-acceptance mix, and the top-k
+    # leg's draft acceptance rate (rides healthy AND outage records).
+    spec = serve.get("spec") or {}
+    for src, dst in (("spec_speedup", "serve_spec_speedup"),
+                     ("spec_accept_rate", "serve_spec_accept_rate"),
+                     ("spec_mean_accept_len",
+                      "serve_spec_mean_accept_len")):
+        if spec.get(src) is not None:
+            out[dst] = spec[src]
     return out
 
 

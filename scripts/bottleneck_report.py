@@ -95,6 +95,15 @@ def main(argv=None) -> int:
             print(f"  {name}: p50 {qs[0.5]:.4g}s  p95 {qs[0.95]:.4g}s  "
                   f"p99 {qs[0.99]:.4g}s  (n={h.get('count', 0)}, "
                   f"bucket-resolution)")
+        spec = (agg.get("histograms") or {}).get("serve_spec_accept_len")
+        if spec and spec.get("count"):
+            # The speculative-decode observable: tokens committed per
+            # verify window (1 = drafts never accepted = the k=0
+            # economics; k+1 = every draft accepted). A dispatch-bound
+            # engine's tokens/s scales with this mean.
+            print(f"  speculation: mean accepted length "
+                  f"{spec['sum'] / spec['count']:.2f} tokens/verify "
+                  f"(n={spec['count']} verify windows)")
     return 0
 
 

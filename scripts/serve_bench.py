@@ -204,6 +204,20 @@ def run_engine_leg(make_engine, workload, concurrency: int,
             "hits", "misses", "hit_rate", "reused_tokens", "entries",
             "evictions", "bytes", "blocks", "inserted_blocks")
             if k in ps}
+    if snap.get("spec_k"):
+        # ISSUE 12 speculation ledger per leg: acceptance rate over
+        # offered drafts + mean committed tokens per verify window
+        # (1 = the k=0 economics, k+1 = every draft accepted) from the
+        # serve_spec_accept_len histogram.
+        acc = snap["spec_tokens_accepted"]
+        rej = snap["spec_tokens_rejected"]
+        h = reg.histogram("serve_spec_accept_len").snapshot()
+        rec["spec_k"] = snap["spec_k"]
+        rec["spec_verifies"] = snap["spec_verifies"]
+        rec["spec_accept_rate"] = round(acc / (acc + rej), 4) \
+            if acc + rej else None
+        rec["spec_mean_accept_len"] = round(h["sum"] / h["count"], 3) \
+            if h["count"] else None
     if errors:
         rec["errors"] = errors[:5]
     return rec
@@ -570,6 +584,201 @@ def run_paged_churn_comparison(n_requests: int = 192,
     return rec
 
 
+# ---------------------------------------------------------------------------
+# speculative-decoding leg (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+_SPEC_KS = (0, 2, 4)
+_SPEC_CONCURRENCIES = (1, 8)
+_SPEC_POOL = 4      # distinct prompts; repeats = retrieval-draft hits
+_SPEC_PHRASE = 6    # prompt = a short phrase repeated (repetitive text)
+_SPEC_PROMPT = 24
+_SPEC_OUT = 64
+_SPEC_MAX_LEN = 256
+_SPEC_CHUNK = 16
+
+
+def make_spec_workload(n: int, vocab: int, seed: int = 7,
+                       n_new: int = _SPEC_OUT):
+    """The high-acceptance mix speculation is measured on (ROADMAP
+    item 2 scopes the ≥2× target to exactly this regime): a small pool
+    of REPETITIVE prompts (a short phrase repeated — the
+    prompt-lookup/self-drafting home turf) requested over and over
+    (the FAQ/retry-storm class the main workload already models).
+    Greedy decode is deterministic, so a repeat's whole stream is
+    predicted token-for-token by the previous completion — retrieval
+    drafting (``serving.draft.HistoryDraft``) turns that into near-k+1
+    commits per verify window, and the batched verify is what makes
+    the retrieved draft PROVEN output rather than a stale-cache
+    answer."""
+    rng = np.random.RandomState(seed)
+    reps = -(-_SPEC_PROMPT // _SPEC_PHRASE)
+    pool = [(rng.randint(0, vocab, _SPEC_PHRASE).tolist()
+             * reps)[:_SPEC_PROMPT] for _ in range(_SPEC_POOL)]
+    return [(pool[rng.randint(_SPEC_POOL)], n_new) for _ in range(n)]
+
+
+def _spec_config():
+    """Spec-leg model: NARROW on purpose. Speculative decoding attacks
+    dispatch-bound sequential decode (one jitted dispatch per token per
+    iteration — the ISSUE 12 floor): on TPU a decode step is
+    memory/dispatch-bound, so a k+1-wide verify costs about one step.
+    On CPU that regime holds only while per-step COMPUTE stays small
+    against the ~ms per-call dispatch — the main serve leg's wide model
+    (chosen so prefill compute dominates dispatch) would instead
+    measure a compute-bound verify, which is not the economics
+    speculation targets. h256×2 keeps the CPU leg dispatch-bound, i.e.
+    TPU-decode-shaped."""
+    from sparkdl_tpu.models.llama import LlamaConfig
+    return LlamaConfig(vocab_size=2048, hidden_size=256, num_layers=2,
+                       num_heads=4, num_kv_heads=2,
+                       intermediate_size=512, rope_theta=10000.0)
+
+
+def _spec_record(legs: dict, ks, concurrencies) -> dict:
+    """Headline ratios: single-stream (c=1) tokens/s of each k leg over
+    the k=0 leg — the ROADMAP item 2 observable — plus the top-k leg's
+    acceptance stats."""
+    rec: dict = {"ks": list(ks), "concurrencies": list(concurrencies),
+                 "legs": legs}
+    base = legs.get("k0_c1") or {}
+    top = legs.get(f"k{max(ks)}_c1") or {}
+    if base.get("tokens_s") and top.get("tokens_s"):
+        rec["spec_speedup"] = round(top["tokens_s"] / base["tokens_s"], 2)
+        rec["spec_speedup_by_k"] = {
+            str(k): round((legs.get(f"k{k}_c1") or {}).get("tokens_s", 0)
+                          / base["tokens_s"], 2)
+            for k in ks if k and legs.get(f"k{k}_c1", {}).get("tokens_s")}
+    c_top = max(concurrencies)
+    if c_top != 1:
+        b8 = legs.get(f"k0_c{c_top}") or {}
+        t8 = legs.get(f"k{max(ks)}_c{c_top}") or {}
+        if b8.get("tokens_s") and t8.get("tokens_s"):
+            rec[f"spec_speedup_c{c_top}"] = round(
+                t8["tokens_s"] / b8["tokens_s"], 2)
+    rec["spec_accept_rate"] = top.get("spec_accept_rate")
+    rec["spec_mean_accept_len"] = top.get("spec_mean_accept_len")
+    return rec
+
+
+def run_spec_comparison_stub(n_requests: int = 32, num_slots: int = 4,
+                             max_len: int = _SPEC_MAX_LEN,
+                             ks=_SPEC_KS,
+                             concurrencies=_SPEC_CONCURRENCIES,
+                             step_s: float = 0.002,
+                             spec_tok_s: float = 5e-5,
+                             vocab: int = 8,
+                             n_new: int = _SPEC_OUT) -> dict:
+    """Jax-free speculative leg: the stub's deterministic token stream
+    is arithmetic mod ``vocab``, so a SMALL vocab makes every output
+    periodic (period = vocab) — repetitive text by construction, the
+    n-gram DEFAULT provider's home turf (no retrieval corpus needed).
+    ``verify`` costs one ``step_s`` + ``spec_tok_s``·k (the marginal
+    verify-width device time), so the k-vs-0 ratio measures dispatch
+    economics — tokens per program dispatch — which is the thing
+    speculation buys on hardware."""
+    from sparkdl_tpu.serving import GenerationEngine, StubBackend
+
+    workload = make_spec_workload(n_requests, vocab, n_new=n_new)
+
+    def make_engine(k: int):
+        return GenerationEngine(
+            StubBackend(num_slots, max_len, vocab_size=vocab,
+                        step_s=step_s, spec_tok_s=spec_tok_s),
+            queue_capacity=max(64, n_requests), prefill_chunk=8,
+            spec_k=k)
+
+    legs = {}
+    outs = {}
+    for k in ks:
+        for c in concurrencies:
+            leg = run_engine_leg(lambda k=k: make_engine(k), workload, c)
+            legs[f"k{k}_c{c}"] = leg
+    # identity: the stub stream is deterministic in the prompt, so the
+    # spec and k=0 engines must emit identical tokens — proven inline
+    # on a fresh engine pair (drained, single-threaded).
+    for k in (0, max(ks)):
+        eng = make_engine(k)
+        hs = [eng.submit(p, max_new_tokens=n) for p, n in workload[:6]]
+        eng.run_until_idle()
+        outs[k] = [h.result(1) for h in hs]
+    rec = {"mode": "stub_spec", "step_s": step_s,
+           "spec_tok_s": spec_tok_s, "vocab": vocab,
+           "num_slots": num_slots, "requests": n_requests,
+           **_spec_record(legs, ks, concurrencies)}
+    rec["spec_token_identical"] = outs[0] == outs[max(ks)]
+    return rec
+
+
+def run_spec_comparison_llama(n_requests: int = 48, num_slots: int = 2,
+                              max_len: int = _SPEC_MAX_LEN,
+                              ks=_SPEC_KS,
+                              concurrencies=_SPEC_CONCURRENCIES) -> dict:
+    """CPU-llama speculative leg (the ROADMAP item 2 acceptance
+    number): single-stream and c=8 runs at k∈{0,2,4} on the
+    dispatch-bound spec model over the high-acceptance retry-storm
+    mix, drafting via ``HistoryDraft`` (retrieval + prompt-lookup
+    fallback). Greedy output is spot-checked token-identical between
+    the k=0 and speculative engines, and the verify program's
+    compile-cache signatures pin zero re-traces across the measured
+    legs."""
+    import jax
+
+    from sparkdl_tpu.core.runtime import GLOBAL_COMPILE_CACHE
+    from sparkdl_tpu.models import llama as L
+    from sparkdl_tpu.serving import GenerationEngine
+    from sparkdl_tpu.serving.draft import HistoryDraft
+
+    cfg = _spec_config()
+    model = L.LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(1),
+                           np.zeros((1, 4), np.int32))
+    workload = make_spec_workload(n_requests, cfg.vocab_size)
+
+    def make_engine(k: int):
+        return GenerationEngine.from_model(
+            model, variables, num_slots=num_slots, max_len=max_len,
+            min_bucket=_MIN_BUCKET, queue_capacity=max(64, n_requests),
+            prefill_chunk=_SPEC_CHUNK, spec_k=k,
+            draft_provider=HistoryDraft() if k else None)
+
+    # warmup: compile every program each k-leg uses (chunk + decode +
+    # one verify program per k), then pin the signature set
+    outs = {}
+    for k in ks:
+        eng = make_engine(k)
+        hs = [eng.submit(p, max_new_tokens=8) for p, _ in workload[:4]]
+        eng.run_until_idle()
+        outs[k] = [h.result(1) for h in hs]
+    identical = all(outs[k] == outs[0] for k in ks)
+    sig_verify = GLOBAL_COMPILE_CACHE.signatures("serve_verify_step")
+    sig_decode = GLOBAL_COMPILE_CACHE.signatures("serve_decode_step")
+
+    legs = {}
+    for k in ks:
+        for c in concurrencies:
+            leg = run_engine_leg(lambda k=k: make_engine(k), workload, c)
+            legs[f"k{k}_c{c}"] = leg
+
+    rec = {
+        "mode": "llama_spec",
+        "platform": jax.default_backend(),
+        "model": {"vocab_size": cfg.vocab_size,
+                  "hidden_size": cfg.hidden_size,
+                  "num_layers": cfg.num_layers},
+        "num_slots": num_slots, "max_len": max_len,
+        "prefill_chunk": _SPEC_CHUNK, "requests": n_requests,
+        "draft_provider": "history",
+        **_spec_record(legs, ks, concurrencies),
+    }
+    rec["spec_token_identical"] = identical
+    rec["verify_retrace_after_warmup"] = (
+        GLOBAL_COMPILE_CACHE.signatures("serve_verify_step") - sig_verify)
+    rec["decode_retrace_after_warmup"] = (
+        GLOBAL_COMPILE_CACHE.signatures("serve_decode_step") - sig_decode)
+    return rec
+
+
 def run_stub_scheduler_comparison(n_requests: int = 96,
                                   num_slots: int = 8,
                                   step_s: float = 0.002,
@@ -610,6 +819,19 @@ def run(mode: str = "llama", rows: int | None = None) -> dict:
                 n_requests=min(192, max(64, n)))
         except Exception as e:  # noqa: BLE001 — the main legs stand
             rec["churn_error"] = f"{type(e).__name__}: {e}"[:300]
+    # ISSUE 12 speculative-decoding leg: single-stream + c=8 at
+    # k∈{0,2,4}. The llama record carries the real-model CPU leg (the
+    # ROADMAP ≥2× single-stream target); the stub record carries the
+    # jax-free scheduler leg — so healthy AND backend_unavailable
+    # records both hold a speculation number (never-host-blind rule).
+    if not os.environ.get("BENCH_SKIP_SPEC"):
+        try:
+            rec["spec"] = run_spec_comparison_stub(
+                n_requests=min(32, max(16, n))) if mode == "stub" \
+                else run_spec_comparison_llama(
+                    n_requests=min(48, max(16, n)))
+        except Exception as e:  # noqa: BLE001 — the main legs stand
+            rec["spec_error"] = f"{type(e).__name__}: {e}"[:300]
     return rec
 
 

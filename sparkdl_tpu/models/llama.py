@@ -201,42 +201,56 @@ class LlamaAttention(nn.Module):
             idx = self.variable("cache", "idx",
                                 lambda: jnp.zeros((), jnp.int32))
             if slot_cur is not None and not self.is_initializing():
-                # Continuous-batching decode step (serving.engine): every
-                # cache row is an INDEPENDENT in-flight request at its own
-                # fill index ``slot_cur[r]`` — the token writes there, and
-                # attention masks per row to [pad_lens[r], slot_cur[r]].
-                # The shared ``idx`` variable is NOT consulted or advanced
-                # (the engine owns per-slot fill state host-side), so slot
-                # refills never disturb the other rows' decode.
+                # Continuous-batching decode step / speculative verify
+                # window (serving.engine): every cache row is an
+                # INDEPENDENT in-flight request at its own fill index
+                # ``slot_cur[r]``. S == 1 is the decode step — the token
+                # writes at the frontier and attention masks per row to
+                # [pad_lens[r], slot_cur[r]]. S == k+1 is the VERIFY
+                # window (ISSUE 12): row r's S tokens (current token +
+                # k drafts) write at [slot_cur[r], slot_cur[r]+S) and
+                # query i attends [pad_lens[r], slot_cur[r]+i] — dense
+                # causal-vs-cache attention under the chunked-prefill
+                # write-frontier invariant: every row at/past the
+                # frontier is (re)written before any attention can read
+                # it, so rejected drafts leave inert garbage the next
+                # real write overwrites. Writes past the row's end are
+                # DROPPED (scatter mode="drop"), never clamped back
+                # over committed rows. The shared ``idx`` variable is
+                # NOT consulted or advanced (the engine owns per-slot
+                # fill state host-side), so slot refills never disturb
+                # the other rows' decode.
                 pads = (jnp.zeros((B,), jnp.int32) if pad_lens is None
                         else pad_lens)
-                pos = jnp.maximum(slot_cur - pads, 0)[:, None]  # [B, 1]
+                qpos = slot_cur[:, None] + jnp.arange(S)[None, :]  # [B,S]
+                pos = jnp.maximum(qpos - pads[:, None], 0)
                 q = rope(q, pos, c.rope_theta)
                 k = rope(k, pos, c.rope_theta)
-                def row_write(cache_b, upd_b, i):
-                    return jax.lax.dynamic_update_slice(
-                        cache_b, upd_b, (0, i, 0))
-
-                k_all = jax.vmap(row_write)(k_cache.value, k, slot_cur)
-                v_all = jax.vmap(row_write)(v_cache.value, v, slot_cur)
+                max_len = k_cache.value.shape[2]
+                rows_ix = jnp.arange(B)[:, None]
+                cols = jnp.where(qpos < max_len, qpos, max_len)  # OOB→drop
+                k_all = k_cache.value.at[rows_ix, :, cols, :].set(
+                    k.transpose(0, 2, 1, 3), mode="drop")
+                v_all = v_cache.value.at[rows_ix, :, cols, :].set(
+                    v.transpose(0, 2, 1, 3), mode="drop")
                 k_cache.value, v_cache.value = k_all, v_all
                 o = None
-                from ..ops import flash_decode as fd
-                dec = fd.decode_fn_for(resolved_attn)
-                if dec is not None and fd.supports(k_all.shape[2]):
-                    # per-row cur: each slot's HBM traffic scales with its
-                    # own fill level (the kernel's dead-block clamp is
-                    # per row).
-                    o = dec(q, k_all, v_all, slot_cur + 1, pads)
+                if S == 1:
+                    from ..ops import flash_decode as fd
+                    dec = fd.decode_fn_for(resolved_attn)
+                    if dec is not None and fd.supports(max_len):
+                        # per-row cur: each slot's HBM traffic scales
+                        # with its own fill level (the kernel's
+                        # dead-block clamp is per row).
+                        o = dec(q, k_all, v_all, slot_cur + 1, pads)
                 if o is None:
-                    max_len = k_all.shape[2]
                     qg = q.reshape(B, c.num_kv_heads, rep, S, hd)
                     s = jnp.einsum("bgrqd,bgkd->bgrqk", qg,
                                    k_all) / math.sqrt(hd)
-                    col = jnp.arange(max_len)[None, :]
-                    valid = ((col <= slot_cur[:, None])
-                             & (col >= pads[:, None]))  # [B, max_len]
-                    s = jnp.where(valid[:, None, None, None],
+                    col = jnp.arange(max_len)[None, None, :]
+                    valid = ((col <= qpos[..., None])
+                             & (col >= pads[:, None, None]))  # [B,S,max]
+                    s = jnp.where(valid[:, None, None],
                                   s.astype(jnp.float32), -1e30)
                     p = jax.nn.softmax(s, axis=-1).astype(d)
                     o = jnp.einsum("bgrqk,bgkd->bgrqd", p, v_all).reshape(
@@ -403,11 +417,13 @@ class LlamaModel(nn.Module):
         callers get the (correct) dense attention over the full cache.
 
         ``slot_cur`` (decode mode, ``[B]`` int32, traced): the
-        continuous-batching step — row r writes its single token at its
-        OWN cache fill index ``slot_cur[r]`` and attends to
-        ``[pad_lens[r], slot_cur[r]]`` of its row. Requires S == 1; the
-        shared ``idx`` cache variable is neither read nor advanced (the
-        serving engine owns per-slot fill state)."""
+        continuous-batching step — row r writes its S tokens at its OWN
+        cache fill index ``[slot_cur[r], slot_cur[r]+S)`` and query i
+        attends ``[pad_lens[r], slot_cur[r]+i]`` of its row. S == 1 is
+        the per-slot decode step; S == k+1 is the speculative VERIFY
+        window (``slot_verify_step``). The shared ``idx`` cache
+        variable is neither read nor advanced (the serving engine owns
+        per-slot fill state)."""
         c = self.cfg
         if pad_lens is not None and not decode:
             raise ValueError(
@@ -415,11 +431,11 @@ class LlamaModel(nn.Module):
                 "training path has no left-pad masking — feed right-padded "
                 "batches with a loss mask instead")
         S = input_ids.shape[1]
-        if slot_cur is not None and (not decode or S != 1):
+        if slot_cur is not None and not decode:
             raise ValueError(
-                "slot_cur is the per-slot decode STEP feature (decode=True, "
-                f"S == 1); got decode={decode}, S={S} — prefill a slot via "
-                "prefill_into_slot instead")
+                "slot_cur is the per-slot decode step / verify-window "
+                f"feature (decode=True); got decode={decode} — prefill a "
+                "slot via prefill_into_slot instead")
         positions = jnp.arange(S)
         x = nn.Embed(c.vocab_size, c.hidden_size, dtype=self.dtype,
                      name="embed_tokens")(input_ids)
@@ -837,6 +853,51 @@ def slot_decode_step(model, params, cache, tokens, slot_cur, pad_lens, rng,
     return nxt, mut["cache"]
 
 
+@functools.partial(jax.jit, static_argnames=("model",),
+                   donate_argnames=("cache",))
+def slot_verify_step(model, params, cache, tokens, slot_cur, pad_lens):
+    """Speculative VERIFY window — the fourth jitted donated-cache slot
+    primitive (ISSUE 12): one batched target forward checks k drafted
+    tokens per slot in a single program dispatch.
+
+    ``tokens``: ``[num_slots, k+1]`` int32 — column 0 is each slot's
+    current token (exactly what ``slot_decode_step`` would consume),
+    columns 1..k its draft candidates (pad freely: a slot drafting
+    fewer than k just computes discarded columns). Row r writes its
+    k+1 K/V rows at ``[slot_cur[r], slot_cur[r]+k]`` and query i
+    attends dense causal-vs-cache to ``[pad_lens[r], slot_cur[r]+i]``
+    — the chunked-prefill write-frontier invariant makes the
+    misspeculated tail inert: rejected rows sit at/past the new
+    frontier and are overwritten before any attention can read them,
+    so **reject is a pure host-side ``cur`` non-advance** — no cache
+    rollback program exists or is needed. Writes past ``max_len`` are
+    dropped in-graph (never clamped back over committed rows); the
+    engine separately caps how many proposals it COMMITS to rows that
+    were really written.
+
+    Returns ``(proposals [num_slots, k+1] int32, cache)`` where
+    ``proposals[r, i]`` is the greedy argmax of the logits at position
+    ``slot_cur[r] + i`` — the token the target emits after consuming
+    ``tokens[r, :i+1]``. Greedy-only by construction (argmax IS the
+    acceptance rule); the engine gates speculation on
+    ``temperature <= 0``. Compiled ONCE per (num_slots, k+1, max_len)
+    — drafting, acceptance and rejection are host-side and never
+    re-trace it.
+
+    Arithmetic note: the window's logits come from the dense
+    causal-vs-cache attention path (S > 1 never rides the
+    flash-decode kernel), so on a backend whose flash and dense
+    reductions round differently an exact logit TIE could argmax-flip
+    a token relative to a flash-decoded ``generate()`` stream; the
+    pinned backends (CPU dense + stub) are exact, and the serve
+    bench's ``spec_token_identical`` gate is the on-chip check."""
+    logits, mut = model.apply({"params": params, "cache": cache},
+                              tokens, decode=True, pad_lens=pad_lens,
+                              slot_cur=slot_cur, mutable=["cache"])
+    props = jnp.argmax(logits.astype(jnp.float32), axis=-1)
+    return props.astype(jnp.int32), mut["cache"]
+
+
 # ---------------------------------------------------------------------------
 # Paged slot primitives (block-table serving — ISSUE 11)
 # ---------------------------------------------------------------------------
@@ -932,6 +993,57 @@ def paged_slot_decode_step(model, params, pool, tables, tokens, slot_cur,
     nxt = _sample(logits[:, -1].astype(jnp.float32), rng, temperature,
                   top_k, top_p)
     return nxt, pool
+
+
+@functools.partial(jax.jit, static_argnames=("model",),
+                   donate_argnames=("pool",))
+def paged_slot_verify_step(model, params, pool, tables, tokens, slot_cur,
+                           pad_lens):
+    """``slot_verify_step`` through the block tables — the paged
+    speculative verify window (ISSUE 12): row r's k+1 positions
+    ``[slot_cur[r], slot_cur[r]+k]`` write through ``tables`` into the
+    shared pool, with the draft window's growth blocks allocated UP
+    FRONT by the engine (``ensure_block_for`` per draft position — a
+    position whose block the pool could not serve routes to the trash
+    block 0 and its proposal is never committed). Attention reads the
+    block-gathered dense view exactly like ``paged_slot_decode_step``;
+    reject is the same pure host-side ``cur`` non-advance — the
+    misspeculated rows are garbage past the frontier, overwritten
+    (or trash-routed) before any attention reads them. Compiled ONCE
+    per (num_slots, max_blocks, pool_blocks, k+1); tables/fill indices
+    traced, so allocation, grafts and refills never re-trace it.
+    Returns ``(proposals [num_slots, k+1] int32, pool)``."""
+    bs = _pool_block_size(pool)
+    dense = _gather_view(pool, tables)
+    logits, mut = model.apply({"params": params, "cache": dense},
+                              tokens, decode=True, pad_lens=pad_lens,
+                              slot_cur=slot_cur, mutable=["cache"])
+    kp1 = tokens.shape[1]
+    pos = slot_cur[:, None] + jnp.arange(kp1)[None, :]   # [S, k+1]
+    bi = pos // bs
+    mb = tables.shape[1]
+    # Positions past the table route to trash block 0 (same rule as the
+    # chunk primitive): a near-full row's overhanging draft columns
+    # land where nobody reads instead of clamping onto live blocks.
+    real = bi < mb
+    blk = jnp.where(real, jnp.take_along_axis(
+        tables, jnp.minimum(bi, mb - 1), axis=1), 0)
+    off = pos % bs
+
+    def scatter(pool_leaf, dense_leaf):
+        if getattr(pool_leaf, "ndim", 0) != 4:
+            return pool_leaf
+        view_len = dense_leaf.shape[2]
+        new = jnp.take_along_axis(
+            dense_leaf, jnp.minimum(pos, view_len - 1)[:, None, :, None],
+            axis=2)                                      # [S, Hkv, k+1, hd]
+        new = jnp.moveaxis(new, 1, 2)                    # [S, k+1, Hkv, hd]
+        return pool_leaf.at[blk, :, off, :].set(
+            new.astype(pool_leaf.dtype))
+
+    pool = jax.tree_util.tree_map(scatter, pool, mut["cache"])
+    props = jnp.argmax(logits.astype(jnp.float32), axis=-1)
+    return props.astype(jnp.int32), pool
 
 
 @functools.partial(

@@ -6,6 +6,8 @@ telemetry plane); the jax half lives in ``backend`` and is imported
 lazily by :meth:`GenerationEngine.from_model`.
 """
 
+from .draft import (DraftModelProvider, HistoryDraft, NGramDraft,
+                    make_provider)
 from .engine import (PREFILLING, EngineStopped, GenerationEngine,
                      QueueFullError, Request, RequestQuarantined,
                      RequestRejected, ServingError, ServingStallError,
@@ -19,5 +21,6 @@ __all__ = [
     "ServingError", "RequestRejected", "QueueFullError",
     "RequestQuarantined", "ServingStallError", "EngineStopped",
     "PREFILLING", "PrefixCache", "RadixPrefixCache", "BlockAllocator",
-    "BlockError", "BlockExhausted", "PagedBlockManager",
+    "BlockError", "BlockExhausted", "PagedBlockManager", "NGramDraft",
+    "HistoryDraft", "DraftModelProvider", "make_provider",
 ]

@@ -341,6 +341,55 @@ class LlamaSlotBackend:
         self._tokens[active] = nxt[active]
         return nxt.tolist()
 
+    # -- speculative verify protocol (ISSUE 12) ---------------------------
+    def _verify_tokens(self, drafts, k: int):
+        """The verify window's token matrix: column 0 is each slot's
+        current token (what the decode step would consume), columns
+        1..k its drafts (zero-padded — a padded column's write lands
+        past the frontier / gets dropped, and its proposal is never
+        committed)."""
+        toks = np.zeros((self.num_slots, int(k) + 1), np.int32)
+        toks[:, 0] = self._tokens
+        for s, d in drafts.items():
+            if d:
+                toks[s, 1:1 + len(d)] = np.asarray(d, np.int32)
+        return toks
+
+    def verify(self, active_slots, drafts, k: int) -> list[list[int]]:
+        """One batched speculative verify window
+        (``models.llama.slot_verify_step`` — the fourth jitted
+        donated-cache slot primitive): k+1 greedy proposals per slot
+        in ONE program dispatch. Does NOT advance any fill state — the
+        engine commits the accepted prefix via :meth:`commit_spec`
+        (reject = no call at all). Greedy-only: the engine gates
+        speculation on ``temperature <= 0``."""
+        if self.temperature > 0.0:
+            raise ValueError("speculative verify is greedy-only "
+                             f"(temperature {self.temperature:g} > 0)")
+        tok_arr = jnp.asarray(self._verify_tokens(drafts, k))
+        cur_arr = jnp.asarray(self._cur)
+        pads_arr = jnp.asarray(self._pads)
+        # One compiled program per (num_slots, k+1, max_len) for the
+        # engine's lifetime: the no-re-trace observable for "drafting /
+        # accept / reject never re-trace the verify".
+        GLOBAL_COMPILE_CACHE.note(
+            "serve_verify_step",
+            (_tree_sig((tok_arr, cur_arr, pads_arr)),
+             _tree_sig(self.cache)))
+        props, self.cache = self._guarded(
+            L.slot_verify_step, self.model, self.params, self.cache,
+            tok_arr, cur_arr, pads_arr)
+        return np.asarray(props).astype(np.int32).tolist()
+
+    def commit_spec(self, slot: int, n_tokens: int, last_tok: int):
+        """Advance ``slot``'s write frontier past the ``n_tokens``
+        positions the verify window committed and pin its current
+        token. Rejected rows sit at/past the new frontier — garbage
+        the next write overwrites before attention reads it, so
+        rollback is exactly this non-advance (no device work)."""
+        self._cur[slot] += int(n_tokens)
+        self._tokens[slot] = int(last_tok)
+
     def _guarded(self, fn, *args, **kw):
         """Run one jitted slot call; if it raises AFTER consuming the
         donated cache (a mid-execution device error — the cache buffer
@@ -599,6 +648,32 @@ class PagedLlamaSlotBackend(LlamaSlotBackend):
         self._cur[active] += 1
         self._tokens[active] = nxt[active]
         return nxt.tolist()
+
+    def verify(self, active_slots, drafts, k: int) -> list[list[int]]:
+        """Paged speculative verify window
+        (``models.llama.paged_slot_verify_step``): the k+1 writes go
+        through each slot's block table — the engine allocated the
+        draft window's growth blocks up front (``ensure_block_for``
+        per draft position), and positions past a slot's table route
+        to the trash block, so a short window never clamps onto live
+        blocks. Frontier state advances only via :meth:`commit_spec`
+        (inherited) — reject is a pure ``cur`` non-advance, the
+        misspeculated rows are garbage past the frontier."""
+        if self.temperature > 0.0:
+            raise ValueError("speculative verify is greedy-only "
+                             f"(temperature {self.temperature:g} > 0)")
+        tok_arr = jnp.asarray(self._verify_tokens(drafts, k))
+        cur_arr = jnp.asarray(self._cur)
+        pads_arr = jnp.asarray(self._pads)
+        tables_arr = jnp.asarray(self.tables)
+        GLOBAL_COMPILE_CACHE.note(
+            "serve_verify_step",
+            (_tree_sig((tok_arr, cur_arr, pads_arr, tables_arr)),
+             _tree_sig(self.cache)))
+        props, self.cache = self._guarded(
+            L.paged_slot_verify_step, self.model, self.params,
+            self.cache, tables_arr, tok_arr, cur_arr, pads_arr)
+        return np.asarray(props).astype(np.int32).tolist()
 
     def release(self, slot: int):
         """Retire/evict/quarantine hook: drop every table reference

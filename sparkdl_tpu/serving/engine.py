@@ -47,6 +47,22 @@ iteration can complete several refills — the admission-rate unlock
 high-churn mixes need. Exhaustion is always backpressure:
 ``RequestRejected`` fires only for requests that can NEVER fit.
 
+**Speculative decoding (ISSUE 12).** ``SPARKDL_SERVE_SPEC_K`` > 0
+replaces each decode iteration with draft → verify → commit: a
+jax-free ``serving.draft`` provider proposes up to k candidate tokens
+per RUNNING slot (n-gram prompt-lookup by default; REST-style
+retrieval over completed requests; or a registry-paired draft model),
+ONE batched verify dispatch (``backend.verify`` — the fourth jitted
+slot primitive) checks them all, and the engine commits the longest
+draft prefix the target's greedy argmax agrees with plus the target's
+own next token — always >= 1 token per slot per iteration, so
+speculation can never emit below the k=0 baseline. Reject is a pure
+frontier non-advance (misspeculated rows are garbage past the write
+frontier — the chunked-prefill invariant), acceptance compares
+argmaxes so the stream stays bit-identical to static ``generate()``
+(greedy-only; sampling backends degrade to k=0 with a warning), and
+k=0 is the EXACT pre-speculation engine.
+
 Design split: this module is **jax-free** — the scheduler, queue, slot
 table, request state machine, streaming callbacks, and failure policy
 are all plain Python against a duck-typed backend (``prefill(slot,
@@ -118,6 +134,12 @@ STALL_FREE_ENV = "SPARKDL_SERVE_STALL_FREE"
 PREFILL_BUDGET_ENV = "SPARKDL_SERVE_PREFILL_BUDGET"
 BLOCK_SIZE_ENV = "SPARKDL_SERVE_BLOCK_SIZE"
 KV_POOL_MB_ENV = "SPARKDL_SERVE_KV_POOL_MB"
+# ISSUE 12 — speculative decoding. SPEC_K is the draft window: 0 (the
+# default) disables speculation entirely — the exact PR 11 decode
+# path; k > 0 replaces each decode iteration with draft -> one batched
+# verify -> greedy commit (always >= 1 token per slot per iteration).
+# SPEC_DRAFT names the draft provider (serving.draft.make_provider).
+SPEC_K_ENV = "SPARKDL_SERVE_SPEC_K"
 
 _DEFAULT_SLOTS = 8
 _DEFAULT_MAX_LEN = 2048
@@ -308,7 +330,8 @@ class StubBackend:
                  seed: int = 0, prefix_cache_bytes: int | None = None,
                  prefix_bytes_per_token: int = 1024,
                  block_size: int | None = None,
-                 pool_blocks: int | None = None):
+                 pool_blocks: int | None = None,
+                 spec_tok_s: float = 0.0):
         from .prefix import PrefixCache, prefix_cache_budget_bytes
         self.num_slots = num_slots
         self.max_len = max_len
@@ -316,6 +339,7 @@ class StubBackend:
         self.step_s = step_s
         self.prefill_s = prefill_s
         self.prefill_tok_s = prefill_tok_s
+        self.spec_tok_s = spec_tok_s
         self.seed = seed
         self.prefix_bytes_per_token = int(prefix_bytes_per_token)
         self._state = [(0, 0)] * num_slots  # (prompt_key, n_emitted)
@@ -422,6 +446,30 @@ class StubBackend:
             self._state[s] = (key, n + 1)
         return out
 
+    # -- speculative verify protocol (ISSUE 12), mirrored jax-free --------
+    def verify(self, active_slots, drafts, k: int) -> list[list[int]]:
+        """One verify window: proposal ``i`` of slot ``s`` is the token
+        the stub's deterministic stream emits after ``i`` accepted
+        drafts — position-determined, independent of the drafts
+        themselves, exactly the greedy-target contract (a draft is
+        accepted iff it equals the stream). Costs ONE step_s sleep
+        (+ ``spec_tok_s`` per draft column — the marginal verify-width
+        device time), so the k=0-vs-k speedup the bench measures is
+        dispatch economics, the thing speculation actually buys."""
+        if self.step_s or (self.spec_tok_s and k):
+            time.sleep(self.step_s + self.spec_tok_s * k)
+        out = [[0] * (k + 1) for _ in range(self.num_slots)]
+        for s in active_slots:
+            key, n = self._state[s]
+            out[s] = [self._tok(key, n + i) for i in range(k + 1)]
+        return out
+
+    def commit_spec(self, slot: int, n_tokens: int, last_tok: int):
+        """Advance the slot's stream past ``n_tokens`` committed
+        positions (reject = simply not advancing)."""
+        key, n = self._state[slot]
+        self._state[slot] = (key, n + int(n_tokens))
+
 
 class GenerationEngine:
     """Iteration-level scheduler over a slot backend (see module doc).
@@ -439,7 +487,9 @@ class GenerationEngine:
                  min_bucket: int | None = None,
                  stall_free: bool | None = None,
                  prefill_chunk: int | None = None,
-                 prefill_budget: int | None = None):
+                 prefill_budget: int | None = None,
+                 spec_k: int | None = None,
+                 draft_provider=None):
         self.backend = backend
         self.eos_id = eos_id
         # Paged backend (ISSUE 11): admission additionally gates on KV-
@@ -491,6 +541,37 @@ class GenerationEngine:
             else _env_num(STALL_ENV, 0.0, float)
         self.min_bucket = min_bucket if min_bucket is not None \
             else _env_num(MIN_BUCKET_ENV, _DEFAULT_MIN_BUCKET)
+        # Speculative decode (ISSUE 12): k = 0 (default) is the EXACT
+        # PR 11 path — no draft provider, no verify program, nothing
+        # speculation-shaped runs. k > 0 requires the backend's verify
+        # protocol AND greedy sampling (acceptance compares argmaxes;
+        # a sampling engine silently degrading to different draws
+        # would break the determinism contract, so it degrades to
+        # k = 0 with a warning instead).
+        self.spec_k = max(0, spec_k if spec_k is not None
+                          else _env_num(SPEC_K_ENV, 0))
+        self._draft = None
+        if self.spec_k > 0:
+            greedy = float(getattr(backend, "temperature", 0.0)
+                           or 0.0) <= 0.0
+            if not hasattr(backend, "verify"):
+                log.warning("backend %s lacks the speculative verify "
+                            "protocol; running without speculation",
+                            type(backend).__name__)
+                self.spec_k = 0
+            elif not greedy:
+                log.warning("speculative decode is greedy-only "
+                            "(acceptance = argmax agreement); backend "
+                            "samples at temperature > 0 — running "
+                            "without speculation")
+                self.spec_k = 0
+            else:
+                from .draft import make_provider
+                self._draft = draft_provider if draft_provider \
+                    is not None else make_provider()
+        # k+1 accept-length buckets (1..k+1 emitted per verify window)
+        self._spec_buckets = tuple(
+            float(i) for i in range(1, self.spec_k + 2)) or None
         self._queue: collections.deque[Request] = collections.deque()
         self._slots: list[Request | None] = [None] * backend.num_slots
         self._lock = threading.Lock()
@@ -514,6 +595,11 @@ class GenerationEngine:
             # request when EVERY running slot is block-stalled)
             "admission_block_waits": 0, "block_stall_events": 0,
             "preemptions": 0,
+            # speculative-decode ledger (ISSUE 12): verify iterations,
+            # draft tokens the target agreed with (each one a decode
+            # dispatch saved) vs rejected (wasted draft+verify columns)
+            "spec_verifies": 0, "spec_tokens_accepted": 0,
+            "spec_tokens_rejected": 0,
         }
 
     # -- construction -----------------------------------------------------
@@ -736,6 +822,11 @@ class GenerationEngine:
             active = self._filter_block_stalled(active)
             if not active:
                 return True
+        if self.spec_k > 0 and self._spec_step(active):
+            return True
+        # k = 0, or a speculative iteration where NO slot drafted
+        # anything: the plain decode step (flash-decode economics, no
+        # wasted k+1-wide verify window)
         toks = self._step_with_isolation()
         if toks is not None:
             self.stats["steps"] += 1
@@ -1215,13 +1306,17 @@ class GenerationEngine:
         req._done.set()
 
     # -- decode step ------------------------------------------------------
-    def _step_with_isolation(self):
-        """Run one backend decode step with the PR 4 retry posture:
-        transient failures retry; past the budget the newest-admitted
-        request (the slot-table state that changed most recently — the
-        suspect) is evicted + quarantined and the step retried, so a
-        poisoned request takes itself out, not the gang. Returns the
-        per-slot token list, or None when every request was evicted."""
+    def _step_with_isolation(self, call=None, stage: str = "decode_step"):
+        """Run one backend decode/verify call with the PR 4 retry
+        posture: transient failures retry; past the budget the
+        newest-admitted request (the slot-table state that changed most
+        recently — the suspect) is evicted + quarantined and the call
+        retried, so a poisoned request takes itself out, not the gang.
+        ``call(slots)`` defaults to the plain decode step; the
+        speculative path passes the batched verify. Returns the
+        backend's result, or None when every request was evicted."""
+        if call is None:
+            call = self.backend.step
         attempts = 0
         while True:
             with self._lock:
@@ -1238,8 +1333,7 @@ class GenerationEngine:
                 # retry budget and quarantines), never engine-fatally.
                 return None
             try:
-                return self._timed(lambda: self.backend.step(slots),
-                                   "decode_step")
+                return self._timed(lambda: call(slots), stage)
             except ServingStallError:
                 raise
             except Exception as e:  # noqa: BLE001 — retry taxonomy below
@@ -1267,6 +1361,108 @@ class GenerationEngine:
                     self._release_slot(victim.slot)
                     self._quarantine(victim, e)
                 attempts = 0
+
+    # -- speculative decode (ISSUE 12) ------------------------------------
+    def _spec_step(self, active) -> bool:
+        """One draft → verify → commit iteration: draft up to ``spec_k``
+        candidates per RUNNING slot (jax-free provider, host-side),
+        check them ALL in one batched target verify, and greedily
+        commit the longest draft prefix the target's argmax agrees
+        with plus the target's own next token — so every slot emits
+        >= 1 token per iteration (a fully-rejected draft degrades to
+        exactly the k=0 decode step's output, never below it). Reject
+        is a pure frontier non-advance: the misspeculated rows sit
+        past the slot's new write frontier and are garbage the next
+        write overwrites before any attention reads them (the PR 9
+        invariant — no rollback program exists). Paged mode allocates
+        each slot's draft-window growth blocks UP FRONT
+        (``ensure_block_for`` per draft position; a position the pool
+        cannot serve just shortens that slot's window — backpressure,
+        never a stall). Returns False — withOUT dispatching anything —
+        when NO slot drafted a single token: the caller then runs the
+        plain decode step, so draftless iterations keep the k=0
+        economics (flash-decode HBM clamp included) instead of paying
+        a wasted k+1-wide dense verify window."""
+        k = self.spec_k
+        drafts: dict[int, list[int]] = {}
+        t0 = time.perf_counter()
+        total_drafted = 0
+        for slot, req in active:
+            # Window caps: never draft past the request's remaining
+            # output (the emission a+1 must not overshoot
+            # max_new_tokens) nor the slot row's last writable position.
+            cap = min(k, req.max_new_tokens - len(req.tokens) - 1,
+                      self.backend.max_len - req.write_pos - 1)
+            d: list[int] = []
+            if cap > 0:
+                try:
+                    d = [int(t) for t in self._draft.propose(
+                        req.prompt + req.tokens, cap)][:cap]
+                except Exception:  # noqa: BLE001 — drafting is an
+                    # optimization; a broken provider costs acceptance,
+                    # never correctness or the loop
+                    log.exception("draft provider failed (request %s)",
+                                  req.id)
+                    d = []
+            if self.paged and d:
+                ok = 0
+                for i in range(len(d)):
+                    if self.backend.ensure_block_for(
+                            slot, req.write_pos + 1 + i):
+                        ok += 1
+                    else:
+                        break
+                d = d[:ok]
+            drafts[slot] = d
+            total_drafted += len(d)
+        if not total_drafted:
+            return False  # nothing to verify — plain decode step
+        # the drafting span tees into StageAccountant /
+        # bottleneck_report like every other serving stage
+        events.completed_span("serve_draft",
+                              time.perf_counter() - t0,
+                              rows=total_drafted)
+        props = self._step_with_isolation(
+            lambda slots: self.backend.verify(
+                slots, {s: drafts.get(s, []) for s in slots}, k),
+            stage="spec_verify")
+        if props is None:
+            return True  # every occupant evicted — nothing to fall to
+        self.stats["steps"] += 1
+        self.stats["spec_verifies"] += 1
+        for slot, req in active:
+            if req.state != RUNNING or req._block_stalled:
+                continue  # evicted mid-isolation / sat this one out
+            prop = [int(t) for t in props[slot]]
+            d = drafts.get(slot, [])
+            a = 0
+            while a < len(d) and prop[a] == d[a]:
+                a += 1
+            self.stats["spec_tokens_accepted"] += a
+            self.stats["spec_tokens_rejected"] += len(d) - a
+            if d:
+                self._metric("counter", "serving_spec_tokens_accepted",
+                             a)
+                self._metric("counter", "serving_spec_tokens_rejected",
+                             len(d) - a)
+            emit = prop[:a + 1]
+            self._metric("histogram", "serve_spec_accept_len",
+                         float(len(emit)), buckets=self._spec_buckets)
+            delivered, last = 0, None
+            for t in emit:
+                if req.state != RUNNING:
+                    break  # retired (EOS / length) mid-window
+                self._deliver(req, t)
+                req.write_pos += 1
+                delivered += 1
+                last = t
+            if delivered and req.state == RUNNING:
+                # Frontier advance past the committed rows; a retired
+                # request's slot was already released (reset) by
+                # _retire, so committing it would corrupt the next
+                # occupant's fill state.
+                self.backend.commit_spec(slot, delivered, last)
+        return True
 
     # -- paged-mode block growth / backpressure ---------------------------
     def _filter_block_stalled(self, active):
@@ -1387,6 +1583,16 @@ class GenerationEngine:
         self._metric("counter", "serving_requests_completed_total")
         self._metric("histogram", "serving_request_latency_s",
                      req.t_done - req.t_submit)
+        if self._draft is not None:
+            # retrieval providers (HistoryDraft) learn from completed
+            # traffic; a broken observer costs future acceptance only
+            obs = getattr(self._draft, "observe", None)
+            if obs is not None:
+                try:
+                    obs(req.prompt, req.tokens)
+                except Exception:  # noqa: BLE001
+                    log.exception("draft observe failed (request %s)",
+                                  req.id)
         req._done.set()
 
     # -- failure plumbing -------------------------------------------------
@@ -1452,6 +1658,7 @@ class GenerationEngine:
                 "prefill_chunk": self.prefill_chunk,
                 "prefill_budget": self.prefill_budget,
                 "paged": self.paged,
+                "spec_k": self.spec_k,
                 **dict(self.stats),
             }
         ps = getattr(self.backend, "prefix_stats", None)

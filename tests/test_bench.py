@@ -142,6 +142,25 @@ def test_paged_engine_beats_per_slot_on_high_churn():
     assert "admission_block_waits" in rec and "preemptions" in rec
 
 
+def test_stub_spec_leg_beats_k0_engine():
+    """ISSUE 12 regression pin without hardware: on the repetitive-text
+    mix (small-vocab stub streams are periodic, so the request's own
+    output is self-predictive — the default n-gram provider's home
+    turf), the k=4 speculative engine must beat the k=0 engine >= 1.5x
+    single-stream tokens/s (bench-record target 2x on the CPU-llama
+    leg), with a sane draft-acceptance floor and token-identical
+    output."""
+    sb = _load_serve_bench()
+    rec = _retry_once(
+        lambda: sb.run_spec_comparison_stub(
+            n_requests=16, ks=(0, 4), concurrencies=(1,),
+            step_s=0.0015, n_new=32),
+        lambda r: r.get("spec_speedup", 0) >= 1.5)
+    assert rec["spec_speedup"] >= 1.5, rec
+    assert rec["spec_accept_rate"] >= 0.3, rec  # acceptance sanity floor
+    assert rec["spec_token_identical"] is True, rec
+
+
 def test_multi_chunk_budget_admits_multiple_slots_per_iteration():
     """The ISSUE 11 budget pin: where the one-chunk PR 9 budget fills 1
     slot per iteration, SPARKDL_SERVE_PREFILL_BUDGET = 2 chunks fills
@@ -219,6 +238,14 @@ def test_all_metric_legs_run_end_to_end_tiny_cpu():
     assert sv["token_identical_spot_check"] is True
     assert all(leg["completed"] == leg["requests"]
                for leg in sv["engine"].values()), sv["engine"]
+    # speculative leg (ISSUE 12): rides the serve record — greedy
+    # identity + zero verify re-traces even at smoke scale, headline
+    # mirrored next to serve_tokens_s
+    spq = sv["spec"]
+    assert spq["spec_token_identical"] is True, spq
+    assert spq["verify_retrace_after_warmup"] == 0, spq
+    assert extra["serve_spec_speedup"] == spq["spec_speedup"]
+    assert extra["serve_spec_accept_rate"] == spq["spec_accept_rate"]
     # backend-free ingest leg (ISSUE 7): a real host-side number with
     # before/after deltas — the record that survives TPU outages
     hi = extra["host_ingest"]

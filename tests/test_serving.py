@@ -782,6 +782,50 @@ class TestEngineOnCpu:
             assert h.result(1) == ref(prompts[0], 6, eos=int(eos))
             assert h.finish_reason in ("eos", "length")
 
+    def test_chunked_prefill_identity_fast_twin(self):
+        """Lean twin of the slow 4-prompt chunked-identity test (ISSUE
+        12 tier-1 buy-back, the PR 8/9/11 pattern): ONE 1-chunk and ONE
+        2-chunk prompt through a 2-slot chunked engine — same
+        engine-vs-generate() identity contract and zero-decode-re-trace
+        pin, a fraction of the compile set. The 3-chunk + prefix-reuse
+        composition runs behind ``slow`` (and the speculative variant
+        of the same composition runs fast in tests/test_spec.py)."""
+        import jax
+
+        from sparkdl_tpu.core.runtime import GLOBAL_COMPILE_CACHE
+        from sparkdl_tpu.models import llama as L
+
+        cfg = L.LlamaConfig.tiny()
+        model = L.LlamaModel(cfg)
+        variables = model.init(jax.random.PRNGKey(0),
+                               np.zeros((1, 4), np.int32))
+        rng = np.random.RandomState(5)
+        max_len = 64
+        prompts = [rng.randint(0, cfg.vocab_size, n).tolist()
+                   for n in (5, 9)]  # 1 and 2 chunks
+        ids, lens = L.left_pad_prompts(prompts)
+        out = np.asarray(L.generate(model, variables, np.asarray(ids), 6,
+                                    pad_lens=np.asarray(lens),
+                                    pad_to=max_len))
+        refs = [out[i][int(lens[i]) + len(p):].tolist()
+                for i, p in enumerate(prompts)]
+        eng = GenerationEngine.from_model(model, variables, num_slots=2,
+                                          max_len=max_len, prefill_chunk=8)
+        handles = [eng.submit(p, max_new_tokens=6) for p in prompts]
+        sig_decode = GLOBAL_COMPILE_CACHE.signatures("serve_decode_step")
+        eng.run_until_idle()
+        snap = eng.snapshot()
+        assert snap["peak_slots_busy"] == 2
+        assert snap["prefill_chunks"] == 1 + 2
+        for h, want in zip(handles, refs):
+            assert h.result(1) == want
+        # ONE decode program for this engine (first compile of its
+        # (slots, max_len) shape at most) — the staggered 1- and
+        # 2-chunk refills never re-trace it
+        assert GLOBAL_COMPILE_CACHE.signatures(
+            "serve_decode_step") - sig_decode <= 1
+
+    @pytest.mark.slow
     def test_chunked_prefill_token_identity_and_prefix_reuse(self):
         """Chunk size 8 over prompts of 3/5/9/17 tokens: refills prefill
         in 1, 2 and 3 chunks, staggered across 2 slots while neighbors

@@ -1,0 +1,518 @@
+"""Speculative decoding on the slot cache (ISSUE 12).
+
+Three layers, leanest first: jax-free draft-provider semantics (the
+prompt-lookup run/periodicity corners, retrieval replay, LRU bounds,
+env resolution, registry pairing), jax-free speculative scheduling
+over the ``StubBackend`` verify mirror (k=0 bypasses everything
+speculation-shaped, identity + acceptance on both cache layouts,
+degrade gates, EOS mid-window, telemetry on/off), then lean CPU-llama
+classes proving greedy output BIT-IDENTICAL to static ``generate()``
+through speculation × chunked prefill × prefix reuse × paging × radix
+grafts × preemption-resume, with zero verify/decode re-traces (the
+compile-signature pin).
+"""
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu.serving import (GenerationEngine, HistoryDraft,
+                                 NGramDraft, StubBackend, make_provider)
+from sparkdl_tpu.serving.draft import _NullDraft
+
+# ---------------------------------------------------------------------------
+# draft providers (jax-free)
+# ---------------------------------------------------------------------------
+
+
+class TestNGramDraft:
+    def test_empty_and_degenerate_inputs(self):
+        p = NGramDraft()
+        assert p.propose([], 4) == []
+        assert p.propose([1], 4) == []  # nothing before the suffix
+        assert p.propose([1, 2, 3], 0) == []
+        assert p.propose([1, 2, 3, 4], 4) == []  # no repeat, no match
+
+    def test_run_match_prefers_full_k_continuation(self):
+        # the newest occurrence of [7,7,7] inside a run overlaps the
+        # suffix and has only 1 token after it — the provider must back
+        # off to an occurrence with a full-k continuation
+        hist = [1, 2] + [7] * 8
+        assert NGramDraft().propose(hist, 4) == [7, 7, 7, 7]
+
+    def test_periodic_pattern_predicts_cycle(self):
+        hist = [5, 6, 7, 8] * 3
+        assert NGramDraft().propose(hist, 4) == [5, 6, 7, 8]
+
+    def test_shorter_continuation_when_nothing_longer_exists(self):
+        # one earlier occurrence, history ends before k tokens follow
+        hist = [5, 6, 9, 5, 6]
+        assert NGramDraft().propose(hist, 4) == [9, 5, 6]
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            NGramDraft(max_ngram=2, min_ngram=3)
+
+
+class TestHistoryDraft:
+    def test_exact_replay_beats_ngram_misalignment(self):
+        # a REPETITIVE cached stream mis-aligns a short n-gram match;
+        # the prefix-replay path must return the exact continuation
+        p = HistoryDraft()
+        prompt, out = [1, 2, 3], [7, 7, 7, 9, 7, 7, 7, 4]
+        p.observe(prompt, out)
+        hist = prompt + out[:3]  # ...7,7,7 — ambiguous for 3-grams
+        assert p.propose(hist, 4) == [9, 7, 7, 7]
+
+    def test_falls_back_to_own_history_then_corpus_ngram(self):
+        p = HistoryDraft()
+        # no corpus: behaves like prompt-lookup
+        assert p.propose([5, 6, 5, 6, 5], 2) == [6, 5]
+        # corpus n-gram (not a prefix replay): shared tail pattern
+        p.observe([40, 41, 42], [43, 44, 45, 46])
+        assert p.propose([9, 41, 42, 43], 3) == [44, 45, 46]
+
+    def test_lru_bound_and_newest_entry_wins(self):
+        p = HistoryDraft(max_entries=2)
+        p.observe([1], [10, 11])
+        p.observe([2], [20, 21])
+        p.observe([3], [30, 31])  # evicts prompt [1]
+        assert len(p._corpus) == 2
+        assert p.propose([1], 2) == []  # evicted
+        assert p.propose([3], 2) == [30, 31]
+        # re-observing a prompt replaces its completion
+        p.observe([3], [33, 34])
+        assert p.propose([3], 2) == [33, 34]
+
+
+class TestMakeProvider:
+    def test_env_resolution(self, monkeypatch):
+        monkeypatch.delenv("SPARKDL_SERVE_SPEC_DRAFT", raising=False)
+        assert isinstance(make_provider(), NGramDraft)
+        assert isinstance(make_provider("history"), HistoryDraft)
+        assert isinstance(make_provider("none"), _NullDraft)
+        assert make_provider("ngram:5").max_ngram == 5
+        assert make_provider("history:7").max_entries == 7
+        monkeypatch.setenv("SPARKDL_SERVE_SPEC_DRAFT", "history")
+        assert isinstance(make_provider(), HistoryDraft)
+        with pytest.raises(ValueError, match="SPARKDL_SERVE_SPEC_DRAFT"):
+            make_provider("medusa")
+        # a malformed tuning suffix fails as loudly as a bad name
+        with pytest.raises(ValueError, match="bad SPARKDL_SERVE_SPEC"):
+            make_provider("ngram:fve")
+        with pytest.raises(ValueError, match="bad SPARKDL_SERVE_SPEC"):
+            make_provider("history:0")
+
+    def test_null_provider_proposes_nothing(self):
+        assert _NullDraft().propose([1, 2, 3, 1, 2, 3], 4) == []
+
+
+class TestRegistryPairing:
+    def test_draft_for_and_register(self):
+        from sparkdl_tpu.models import registry
+        assert registry.draft_for("llama3_8b") == "llama_small"
+        assert registry.draft_for("llama_small") == "llama_tiny"
+        assert registry.draft_for("unknown-family") is None
+        registry.register_draft_pair("my_target", "llama_tiny")
+        try:
+            assert registry.draft_for("my_target") == "llama_tiny"
+        finally:
+            registry.DRAFT_PAIRS.pop("my_target", None)
+        with pytest.raises(ValueError, match="itself"):
+            registry.register_draft_pair("x", "x")
+
+    def test_llm_config_names(self):
+        from sparkdl_tpu.models import registry
+        cfg = registry.llm_config("llama_tiny")
+        assert cfg.num_layers == 2
+        assert registry.llm_config("llama_small").hidden_size == 2048
+        with pytest.raises(ValueError, match="Unknown LLM config"):
+            registry.llm_config("gpt5")
+
+
+# ---------------------------------------------------------------------------
+# speculative scheduling over the stub mirror (jax-free)
+# ---------------------------------------------------------------------------
+
+
+def _spec_workload():
+    # small vocab -> the stub's arithmetic stream is periodic -> the
+    # request's own output is n-gram-predictable after one period
+    return [([1, 2, 3], 24), ([4, 5], 24), ([1, 2, 3, 4, 5, 6], 24)]
+
+
+def _run_stub(spec_k, *, eos_id=None, provider=None, vocab=8, **bkw):
+    eng = GenerationEngine(
+        StubBackend(4, 64, vocab_size=vocab, **bkw), spec_k=spec_k,
+        eos_id=eos_id, draft_provider=provider)
+    hs = [eng.submit(p, max_new_tokens=n) for p, n in _spec_workload()]
+    eng.run_until_idle()
+    return [h.result(1) for h in hs], eng.snapshot()
+
+
+class TestSpecStubEngine:
+    def test_identity_acceptance_and_fewer_steps_both_layouts(self):
+        base, s0 = _run_stub(0)
+        spec, s4 = _run_stub(4)
+        assert spec == base  # bit-identical stream
+        assert s4["spec_k"] == 4 and s4["spec_tokens_accepted"] > 0
+        assert s4["steps"] < s0["steps"]  # fewer program dispatches
+        # paged layout: same stream, same win, through the block tables
+        base_p, p0 = _run_stub(0, block_size=4, pool_blocks=80)
+        spec_p, p4 = _run_stub(4, block_size=4, pool_blocks=80)
+        assert base_p == spec_p == base
+        assert p4["spec_tokens_accepted"] > 0
+        assert p4["steps"] < p0["steps"]
+
+    def test_k0_is_exactly_the_pr11_path(self):
+        class VerifyPoison(StubBackend):
+            def verify(self, active_slots, drafts, k):
+                raise AssertionError("k=0 must never touch verify")
+
+        eng = GenerationEngine(VerifyPoison(2, 64, vocab_size=8),
+                               spec_k=0)
+        h = eng.submit([1, 2, 3], max_new_tokens=6)
+        eng.run_until_idle()
+        assert len(h.result(1)) == 6
+        snap = eng.snapshot()
+        assert snap["spec_k"] == 0 and snap["spec_verifies"] == 0
+        assert eng._draft is None  # nothing speculation-shaped armed
+
+    def test_backend_without_verify_degrades_to_k0(self):
+        class OldBackend:
+            num_slots, max_len = 2, 64
+
+            def __init__(self):
+                self._n = {}
+
+            def prefill(self, slot, prompt, bucket):
+                self._n[slot] = 1
+                return 7
+
+            def step(self, active):
+                out = [0] * self.num_slots
+                for s in active:
+                    out[s] = (7 + self._n[s]) % 97
+                    self._n[s] += 1
+                return out
+
+        eng = GenerationEngine(OldBackend(), spec_k=4)
+        assert eng.spec_k == 0  # degraded, warned, still serving
+        h = eng.submit([1, 2], max_new_tokens=3)
+        eng.run_until_idle()
+        assert len(h.result(1)) == 3
+
+    def test_sampling_backend_degrades_to_k0(self):
+        class Sampling(StubBackend):
+            temperature = 0.7
+
+        eng = GenerationEngine(Sampling(2, 64, vocab_size=8), spec_k=4)
+        assert eng.spec_k == 0  # greedy-only: acceptance is argmax
+
+    def test_draftless_iterations_fall_through_to_plain_step(self):
+        """A null provider must cost NOTHING over k=0: no verify
+        dispatch runs (draftless iterations take the plain decode
+        step — flash-decode economics preserved), and the output is
+        the k=0 stream exactly."""
+        class VerifyPoison(StubBackend):
+            def verify(self, active_slots, drafts, k):
+                raise AssertionError("draftless iteration ran verify")
+
+        base, s0 = _run_stub(0)
+        eng = GenerationEngine(VerifyPoison(4, 64, vocab_size=8),
+                               spec_k=4, draft_provider=_NullDraft())
+        hs = [eng.submit(p, max_new_tokens=n)
+              for p, n in _spec_workload()]
+        eng.run_until_idle()
+        assert [h.result(1) for h in hs] == base
+        snap = eng.snapshot()
+        assert snap["spec_verifies"] == 0
+        assert snap["steps"] == s0["steps"]  # exact k=0 economics
+
+    def test_broken_draft_provider_never_kills_the_loop(self):
+        class Broken:
+            def propose(self, history, k):
+                raise RuntimeError("draft meltdown")
+
+        base, _ = _run_stub(0)
+        out, snap = _run_stub(4, provider=Broken())
+        assert out == base
+        assert snap["completed"] == len(_spec_workload())
+
+    def test_eos_mid_window_matches_k0(self):
+        # pick an eos value the deterministic stream emits mid-request
+        base, _ = _run_stub(0)
+        eos = base[0][3]
+        ref, s0 = _run_stub(0, eos_id=eos)
+        out, s4 = _run_stub(4, eos_id=eos)
+        assert out == ref  # truncated at the same token
+        assert all(t.count(eos) <= 1 for t in out)
+        assert s4["completed"] == s0["completed"]
+
+    def test_history_provider_observe_learns_completed_traffic(self):
+        prov = HistoryDraft()
+        eng = GenerationEngine(StubBackend(2, 64, vocab_size=8),
+                               spec_k=4, draft_provider=prov)
+        h1 = eng.submit([1, 2, 3], max_new_tokens=12)
+        eng.run_until_idle()
+        assert len(prov._corpus) == 1  # retirement fed the corpus
+        snap1 = dict(eng.snapshot())
+        h2 = eng.submit([1, 2, 3], max_new_tokens=12)  # retry storm
+        eng.run_until_idle()
+        assert h2.result(1) == h1.result(1)
+        warm_acc = eng.snapshot()["spec_tokens_accepted"] \
+            - snap1["spec_tokens_accepted"]
+        assert warm_acc >= 8  # the replay predicts nearly everything
+
+    def test_preemption_resume_with_speculation_on(self):
+        # the PR 11 total-stall preemption corner with spec enabled:
+        # both layouts' streams must stay identical to the k=0 run and
+        # every block must come home
+        def run(k):
+            be = StubBackend(2, 64, vocab_size=8, block_size=4,
+                             pool_blocks=6, prefix_cache_bytes=0)
+            eng = GenerationEngine(be, prefill_chunk=4, spec_k=k)
+            a = eng.submit([1, 2, 3, 4], max_new_tokens=12)
+            b = eng.submit([5, 6, 7, 0], max_new_tokens=12)
+            eng.run_until_idle()
+            return [a.result(1), b.result(1)], eng.snapshot(), be
+
+        ref, snap0, _ = run(0)
+        out, snap4, be = run(4)
+        assert out == ref
+        assert snap4["preemptions"] >= 1  # the corner actually fired
+        assert snap4["completed"] == 2 and snap4["quarantined"] == 0
+        assert be.allocator.used_count() == 0
+
+    def test_spec_metrics_when_plane_armed_and_zero_when_off(self):
+        from sparkdl_tpu.runner import telemetry
+        telemetry.reset()
+        telemetry.start()
+        try:
+            eng = GenerationEngine(StubBackend(2, 64, vocab_size=8),
+                                   spec_k=4)
+            h = eng.submit([1, 2, 3], max_new_tokens=16)
+            eng.run_until_idle()
+            assert h.result(1)
+            snap = telemetry.registry().snapshot()
+            assert snap["counters"].get(
+                "serving_spec_tokens_accepted", 0) > 0
+            assert "serving_spec_tokens_rejected" in snap["counters"]
+            hist = snap["histograms"]["serve_spec_accept_len"]
+            # k+1 accept-length buckets: 1..k+1 committed per window
+            assert hist["bounds"] == [1.0, 2.0, 3.0, 4.0, 5.0]
+            assert hist["count"] == eng.snapshot()["spec_verifies"]
+        finally:
+            telemetry.reset()
+        # plane off: zero registration (the PR 8-11 rule)
+        telemetry.reset()
+        eng = GenerationEngine(StubBackend(2, 64, vocab_size=8),
+                               spec_k=4)
+        eng.submit([1, 2, 3], max_new_tokens=8)
+        eng.run_until_idle()
+        assert telemetry.registry().snapshot() == {
+            "counters": {}, "gauges": {}, "histograms": {}}
+
+    def test_draft_span_reaches_flight_recorder(self):
+        from sparkdl_tpu.runner import events
+        rec = events.reset()
+        eng = GenerationEngine(StubBackend(2, 64, vocab_size=8),
+                               spec_k=4)
+        eng.submit([1, 2, 3], max_new_tokens=16)
+        eng.run_until_idle()
+        names = [e["name"] for e in rec.ring]
+        assert "serve_draft" in names
+
+    def test_bottleneck_report_prints_mean_accepted_length(
+            self, tmp_path, capsys):
+        import importlib.util
+        import json
+        import os
+        snap = {"t": 1.0, "rank": 0, "elapsed_s": 1.0, "stages": {},
+                "histograms": {"serve_spec_accept_len": {
+                    "bounds": [1.0, 2.0, 3.0], "buckets": [4, 6, 10],
+                    "count": 10, "sum": 21.0}}}
+        (tmp_path / "metrics_rank0.json").write_text(json.dumps(snap))
+        spec = importlib.util.spec_from_file_location(
+            "bottleneck_report",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "scripts",
+                "bottleneck_report.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        rc = mod.main([str(tmp_path / "no-events"), "--metrics-dir",
+                       str(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "mean accepted length 2.10 tokens/verify" in out
+
+
+# ---------------------------------------------------------------------------
+# speculative engine on CPU over the tiny model (lean: shapes shared
+# with the test_serving / test_paging CPU classes, so the only NEW
+# compiles are the verify programs)
+# ---------------------------------------------------------------------------
+
+
+class TestSpecOnCpu:
+    def _model(self):
+        import jax
+
+        from sparkdl_tpu.models import llama as L
+        cfg = L.LlamaConfig.tiny()
+        model = L.LlamaModel(cfg)
+        variables = model.init(jax.random.PRNGKey(0),
+                               np.zeros((1, 4), np.int32))
+        return cfg, model, variables
+
+    def _refs(self, model, variables, prompts, new, max_len=64):
+        from sparkdl_tpu.models import llama as L
+        ids, lens = L.left_pad_prompts(prompts)
+        out = np.asarray(L.generate(model, variables, np.asarray(ids),
+                                    new, pad_lens=np.asarray(lens),
+                                    pad_to=max_len))
+        return [out[i][int(lens[i]) + len(p):].tolist()
+                for i, p in enumerate(prompts)]
+
+    def test_spec_identity_chunked_prefill_and_prefix_reuse(self):
+        """Unpaged: 1/2/3-chunk prompts decode speculatively (k=3,
+        n-gram self-drafting) bit-identical to static generate();
+        shared-head prompts ride a prefix-cache hit and stay
+        identical; ONE verify signature for the engine's lifetime and
+        zero decode re-traces."""
+        from sparkdl_tpu.core.runtime import GLOBAL_COMPILE_CACHE
+
+        cfg, model, variables = self._model()
+        rng = np.random.RandomState(5)
+        max_len, new = 64, 6
+        prompts = [rng.randint(0, cfg.vocab_size, n).tolist()
+                   for n in (5, 17)]
+        head = rng.randint(0, cfg.vocab_size, 12).tolist()
+        pa = head + rng.randint(0, cfg.vocab_size, 4).tolist()
+        pb = head + rng.randint(0, cfg.vocab_size, 7).tolist()
+        refs = self._refs(model, variables, prompts + [pa, pb], new)
+
+        eng = GenerationEngine.from_model(model, variables, num_slots=2,
+                                          max_len=max_len,
+                                          prefill_chunk=8, spec_k=3)
+        assert eng.spec_k == 3
+        hs = [eng.submit(p, max_new_tokens=new) for p in prompts]
+        eng.run_until_idle()
+        for p, h, want in zip(prompts, hs, refs):
+            assert h.result(1) == want, len(p)
+        sig_v = GLOBAL_COMPILE_CACHE.signatures("serve_verify_step")
+        sig_d = GLOBAL_COMPILE_CACHE.signatures("serve_decode_step")
+        assert sig_v >= 1
+
+        ha = eng.submit(pa, max_new_tokens=new)
+        eng.run_until_idle()  # commits pa's head to the prefix cache
+        hb = eng.submit(pb, max_new_tokens=new)
+        eng.run_until_idle()
+        assert ha.result(1) == refs[2] and hb.result(1) == refs[3]
+        assert eng.snapshot()["prefix_cache"]["hits"] >= 1
+        snap = eng.snapshot()
+        assert snap["spec_verifies"] > 0
+        # acceptance/rejection never re-trace verify or decode
+        assert GLOBAL_COMPILE_CACHE.signatures(
+            "serve_verify_step") == sig_v
+        assert GLOBAL_COMPILE_CACHE.signatures(
+            "serve_decode_step") == sig_d
+
+    def test_paged_spec_identity_graft_and_preemption_resume(self):
+        """Paged: speculative decode through the block tables with a
+        radix graft AND a mid-decode preemption-resume — the resumed
+        stream and the grafted stream must both stay bit-identical to
+        static generate(), with zero verify re-traces through
+        allocation, graft, preempt and resume."""
+        from sparkdl_tpu.core.runtime import GLOBAL_COMPILE_CACHE
+
+        cfg, model, variables = self._model()
+        rng = np.random.RandomState(7)
+        # 12 output tokens: with near-full acceptance a verify window
+        # commits ~4/iteration, so the request is still RUNNING at the
+        # preemption point below
+        max_len, new = 64, 12
+        head = rng.randint(0, cfg.vocab_size, 16).tolist()  # 2 blocks
+        pa = head + rng.randint(0, cfg.vocab_size, 3).tolist()
+        pb = head + rng.randint(0, cfg.vocab_size, 6).tolist()
+        refs = self._refs(model, variables, [pa, pb], new)
+
+        # warm retrieval corpus (the retry-storm steady state): every
+        # decode iteration drafts deterministically — including the
+        # resumed request, whose history is a prefix of its cached
+        # completion — so the paged verify path is exercised on every
+        # step, with high acceptance driving multi-token commits
+        # through the block tables.
+        prov = HistoryDraft()
+        prov.observe(pa, refs[0])
+        prov.observe(pb, refs[1])
+        eng = GenerationEngine.from_model(
+            model, variables, num_slots=2, max_len=max_len,
+            prefill_chunk=8, block_size=8, prefill_budget=16, spec_k=3,
+            draft_provider=prov)
+        assert eng.paged and eng.spec_k == 3
+        ha = eng.submit(pa, max_new_tokens=new)
+        eng.step()  # 2 of pa's 3 chunks (budget 16)
+        eng.step()  # final chunk + first token (+ a verify window)
+        assert ha.state == "running"
+        sig_v = GLOBAL_COMPILE_CACHE.signatures("serve_verify_step")
+        eng.step()  # >= 1 speculative verify ran
+        assert eng.snapshot()["spec_verifies"] >= 1
+        # preempt pa mid-decode (still RUNNING — the production caller
+        # only ever preempts running slots): resume must re-prefill
+        # prompt+tokens and keep decoding speculatively, bit-identically
+        assert ha.state == "running" and 0 < len(ha.tokens) < new
+        eng._preempt_newest([(ha.slot, ha)])
+        hb = eng.submit(pb, max_new_tokens=new)  # grafts pa's... head
+        eng.run_until_idle()
+        assert ha.result(1) == refs[0]
+        assert hb.result(1) == refs[1]
+        snap = eng.snapshot()
+        assert snap["preemptions"] == 1
+        assert snap["spec_verifies"] >= 2
+        assert GLOBAL_COMPILE_CACHE.signatures(
+            "serve_verify_step") == sig_v  # one paged verify program
+        assert eng.backend.allocator.used_count() == \
+            len(eng.backend.mgr.radix or [])
+
+    def test_blocking_path_spec_identity_with_left_pad(self):
+        """Blocking (left-padded) layout + speculation: the verify
+        window's rope positions and attention mask are pad-RELATIVE
+        (prompts of 5 and 7 tokens in the 8-bucket carry pads 3 and
+        1), and the stream must still equal static generate()."""
+        cfg, model, variables = self._model()
+        rng = np.random.RandomState(3)
+        # repetitive prompts: prompt-lookup drafts from iteration one,
+        # so the left-pad verify math actually runs (draftless
+        # iterations fall through to the plain step)
+        pieces = [rng.randint(0, cfg.vocab_size, 3).tolist()
+                  for _ in range(2)]
+        prompts = [(pieces[0] * 2)[:5], (pieces[1] * 3)[:7]]
+        refs = self._refs(model, variables, prompts, 6)
+        eng = GenerationEngine.from_model(
+            model, variables, num_slots=2, max_len=64, min_bucket=8,
+            stall_free=False, spec_k=3)
+        hs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+        eng.run_until_idle()
+        for h, want in zip(hs, refs):
+            assert h.result(1) == want
+        assert eng.snapshot()["spec_verifies"] > 0
+
+    def test_draft_model_provider_registry_pairing(self):
+        """The registry-paired draft model drafts k tokens through the
+        static generate() path (mechanics + pairing; acceptance
+        quality needs trained weights, which the zero-egress container
+        does not have)."""
+        from sparkdl_tpu.serving.draft import DraftModelProvider
+
+        with pytest.raises(ValueError, match="no draft pairing"):
+            DraftModelProvider.from_registry("not-a-family")
+        prov = DraftModelProvider.from_registry("llama_small",
+                                                min_bucket=8)
+        assert prov.model.cfg.num_layers == 2  # llama_tiny, per pairing
+        d = prov.propose([1, 2, 3, 4, 5], 3)
+        assert len(d) == 3
+        assert all(0 <= t < prov.model.cfg.vocab_size for t in d)
+        # deterministic (greedy draft)
+        assert prov.propose([1, 2, 3, 4, 5], 3) == d
+        # history outside the draft vocab: stand down, never crash
+        assert prov.propose([10 ** 6], 3) == []
