@@ -1136,6 +1136,21 @@ def _serve_headline(serve: dict) -> dict:
            "serve_decode_stall_s": top.get("decode_stall_s"),
            "serve_prefix_cache_hit_rate":
                (top.get("prefix_cache") or {}).get("hit_rate")}
+    # ISSUE 13: SLO compliance + the slowest request's phase breakdown
+    # ride the headline in BOTH the healthy and backend_unavailable
+    # records (never-host-blind rule) — the bench states compliance,
+    # not just percentiles, and the attribution residual proves the
+    # trace phases sum to measured latency.
+    leg_slo = top.get("slo") or {}
+    out["serve_slo_ttft_compliance"] = leg_slo.get("ttft_compliance")
+    out["serve_slo_latency_compliance"] = \
+        leg_slo.get("latency_compliance")
+    if top.get("slowest_trace") is not None:
+        out["serve_slowest_trace"] = top["slowest_trace"]
+    ta = top.get("trace_attribution") or {}
+    if ta.get("max_unattributed_frac") is not None:
+        out["serve_trace_max_unattributed_frac"] = \
+            ta["max_unattributed_frac"]
     for k in ("speedup_vs_blocking", "ttft_p99_ratio",
               "decode_stall_ratio"):
         if serve.get(k) is not None:

@@ -50,7 +50,13 @@ def main(argv=None) -> int:
                          "of the table")
     ns = ap.parse_args(argv)
 
-    rep = analysis.analyze(event_dir=ns.event_dir)
+    recs = analysis.load_event_dir(ns.event_dir)
+    rep = analysis.analyze(events=recs) if recs else None
+    # ISSUE 13: when the stream holds serve_* spans, the stage table is
+    # not the whole story — append the request-trace tail (slowest
+    # requests, phase-attributed) and the SLO compliance block so the
+    # report states compliance, not just percentiles.
+    req = analysis.request_summary(recs) if recs else None
     agg = telemetry.aggregate_snapshots(ns.metrics_dir) \
         if ns.metrics_dir else None
     if rep is None and agg is None:
@@ -61,8 +67,8 @@ def main(argv=None) -> int:
         return 2
 
     if ns.json:
-        print(json.dumps({"report": rep, "gang_metrics": agg},
-                         default=str))
+        print(json.dumps({"report": rep, "gang_metrics": agg,
+                          "requests": req}, default=str))
         return 0
     if rep is not None:
         print(analysis.format_report(rep))
@@ -104,6 +110,11 @@ def main(argv=None) -> int:
             print(f"  speculation: mean accepted length "
                   f"{spec['sum'] / spec['count']:.2f} tokens/verify "
                   f"(n={spec['count']} verify windows)")
+    if req is not None:
+        print()
+        print(analysis.format_request_summary(req))
+        print("(per-request detail: scripts/request_report.py "
+              f"{ns.event_dir})")
     return 0
 
 

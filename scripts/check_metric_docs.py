@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Doc-drift lint: every metric name registered through
+``telemetry.registry()`` must be documented in the README (ISSUE 13
+satellite — the metrics twin of ``check_env_docs.py``).
+
+PRs 6–12 grew ~25 counter/gauge/histogram names; each is one rename (or
+one new metric) away from silently drifting out of the README's metrics
+reference. This lint greps ``sparkdl_tpu/`` (plus ``bench.py`` and
+``scripts/``) for registration call sites —
+``.counter("name")`` / ``.gauge("name")`` / ``.histogram("name")`` and
+the serving engine's ``_metric("kind", "name", ...)`` helper — and
+fails loudly when any literal name is missing from ``README.md``.
+(Names built dynamically escape the grep, same limitation as any
+source lint; the codebase registers with literals for exactly this
+reason.) Stdlib-only, no package import — it must run anywhere, fast,
+as a tier-1 test and standalone in CI:
+
+    python scripts/check_metric_docs.py      # exit 1 + list on drift
+"""
+
+import os
+import re
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# Registration call sites: reg.counter("x") / .gauge("x") /
+# .histogram("x", ...) and the engine's _metric("gauge", "x", ...)
+# indirection. Only literal first-argument names are caught.
+_CALL_RE = re.compile(
+    r"\.(?:counter|gauge|histogram)\(\s*['\"]([A-Za-z_][A-Za-z0-9_]*)['\"]")
+_HELPER_RE = re.compile(
+    r"_metric\(\s*['\"](?:counter|gauge|histogram)['\"]\s*,\s*"
+    r"['\"]([A-Za-z_][A-Za-z0-9_]*)['\"]")
+
+
+def _py_files(root: str):
+    roots = [os.path.join(root, "sparkdl_tpu"),
+             os.path.join(root, "scripts"),
+             os.path.join(root, "bench.py")]
+    for top in roots:
+        if os.path.isfile(top):
+            yield top
+            continue
+        for dirpath, dirnames, filenames in os.walk(top):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for f in filenames:
+                if f.endswith(".py"):
+                    yield os.path.join(dirpath, f)
+
+
+def code_metric_names(root: str = _REPO) -> set[str]:
+    """Every metric name registered (with a literal) by package/bench/
+    scripts code."""
+    out: set[str] = set()
+    for path in _py_files(root):
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                src = f.read()
+        except OSError:
+            continue
+        out.update(_CALL_RE.findall(src))
+        out.update(_HELPER_RE.findall(src))
+    return out
+
+
+def documented_metric_names(code_names: set[str],
+                            readme: str | None = None) -> set[str]:
+    """The subset of ``code_names`` that appear verbatim in the
+    README."""
+    readme = readme or os.path.join(_REPO, "README.md")
+    try:
+        with open(readme, encoding="utf-8", errors="replace") as f:
+            text = f.read()
+    except OSError:
+        return set()
+    return {n for n in code_names if n in text}
+
+
+def missing_metrics(root: str = _REPO,
+                    readme: str | None = None) -> list[str]:
+    """Metric names registered in code but absent from the README,
+    sorted."""
+    code = code_metric_names(root)
+    return sorted(code - documented_metric_names(code, readme))
+
+
+def main() -> int:
+    missing = missing_metrics()
+    if missing:
+        print("check_metric_docs: metric names registered through "
+              "telemetry.registry() but missing from README.md:",
+              file=sys.stderr)
+        for n in missing:
+            print(f"  {n}", file=sys.stderr)
+        print("Document each in the README metrics reference "
+              "(Live telemetry & bottleneck attribution section).",
+              file=sys.stderr)
+        return 1
+    n = len(code_metric_names())
+    print(f"check_metric_docs: ok — {n} metric names all documented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

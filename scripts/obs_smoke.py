@@ -217,6 +217,138 @@ def _ingest_leg(out_dir: str) -> dict:
     }
 
 
+def _serving_leg(out_dir: str) -> dict:
+    """ISSUE 13: a stub-backend engine under load with the plane armed —
+    ``/serving`` must answer MID-run with a live slot map; afterwards
+    ``request_report.py`` must name the dominant phase of the slowest
+    request; the SLO monitor must report compliance >= 0.99 on the
+    healthy leg and flip the burn-rate gauge (+ breach event) on an
+    injected-slowness leg. Jax-free throughout (StubBackend)."""
+    import subprocess
+    import time
+    import urllib.request
+
+    metrics_dir = os.path.join(out_dir, "serve_metrics")
+    event_dir = os.path.join(out_dir, "serve_events")
+    os.environ["SPARKDL_EVENT_DIR"] = event_dir
+    os.environ["SPARKDL_SLO_TTFT_S"] = "0.5"
+    os.environ["SPARKDL_SLO_LATENCY_S"] = "30"
+    os.environ["SPARKDL_SLO_WINDOWS_S"] = "1,5"
+    os.environ["SPARKDL_METRICS_INTERVAL_S"] = "0.1"
+    try:
+        from sparkdl_tpu.runner import events, slo, telemetry
+        from sparkdl_tpu.serving import GenerationEngine, StubBackend
+
+        events.reset()
+        slo.reset()
+        telemetry.reset()
+        telemetry.start(metrics_dir=metrics_dir, port=0)
+        port = telemetry.server_port()
+
+        # -- healthy leg: fast stub, a burst larger than the slot table
+        # (the tail's dominant phase is queue wait — attribution food)
+        eng = GenerationEngine(StubBackend(4, 128, step_s=0.002),
+                               prefill_chunk=8)
+        eng.start()
+        handles = [eng.submit([1 + i, 2, 3], max_new_tokens=16)
+                   for i in range(24)]
+        live = None
+        deadline = time.time() + 30
+        while time.time() < deadline and live is None:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/serving",
+                        timeout=5) as resp:
+                    body = json.loads(resp.read().decode())
+            except OSError:
+                break
+            engines = body.get("engines") or []
+            if engines and engines[0].get("slots_busy", 0) > 0:
+                live = engines[0]  # a live slot map, mid-run
+            else:
+                time.sleep(0.005)
+        for h in handles:
+            h.wait(60)
+        eng.stop(drain=True, timeout=30)
+        healthy_slo = (telemetry.snapshot().get("slo") or {}) \
+            .get("objectives", {}).get("ttft", {})
+
+        # -- chaos leg: injected slowness — each prefill chunk sleeps
+        # 0.8 s, so every TTFT blows the 0.5 s objective and the
+        # multi-window burn rate must flip
+        time.sleep(1.1)  # past the short window: the chaos traffic is
+        # the only thing the 1 s window sees
+        eng2 = GenerationEngine(StubBackend(2, 128, prefill_s=0.8),
+                                prefill_chunk=8)
+        for i in range(2):
+            eng2.submit([50 + i, 2, 3], max_new_tokens=4)
+        eng2.run_until_idle()
+        chaos = telemetry.snapshot().get("slo") or {}
+        chaos_ttft = chaos.get("objectives", {}).get("ttft", {})
+        burn_gauge = telemetry.registry().snapshot()["gauges"] \
+            .get("slo_ttft_burn_rate") or {}
+        breach_event = any(e.get("name") == "slo_breach"
+                           for e in events.get_recorder().tail())
+        telemetry.stop()
+        telemetry.reset()
+        slo.reset()
+        events.reset()  # close the stream so the report reads full books
+
+        report = {}
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(_REPO, "scripts", "request_report.py"),
+             event_dir, "--json"],
+            capture_output=True, text=True, timeout=120)
+        if proc.returncode == 0:
+            for line in reversed(proc.stdout.strip().splitlines()):
+                if line.startswith("{"):
+                    report = json.loads(line)
+                    break
+        slowest = (report.get("slowest") or [{}])[0]
+        live_states = {s.get("state")
+                       for s in (live or {}).get("slots", [])}
+        healthy_compliance = healthy_slo.get("compliance")
+        return {
+            "serving_endpoint_live_mid_run": live is not None,
+            "live_slots_busy": (live or {}).get("slots_busy"),
+            "live_queue_depth": ((live or {}).get("queue") or {})
+            .get("depth"),
+            "live_slot_states": sorted(s for s in live_states if s),
+            "healthy_ttft_compliance": healthy_compliance,
+            "chaos_breaching": chaos_ttft.get("breaching"),
+            "chaos_burn_rate": chaos_ttft.get("burn_rate"),
+            "burn_gauge_value": burn_gauge.get("value"),
+            "slo_breach_event": breach_event,
+            "report_rc": proc.returncode,
+            "report_completed": report.get("completed"),
+            "slowest_dominant_phase": slowest.get("dominant_phase"),
+            "max_unattributed_frac":
+                report.get("max_unattributed_frac"),
+            "ok": live is not None
+            and bool(live_states & {"running", "prefilling"})
+            and healthy_compliance is not None
+            and healthy_compliance >= 0.99
+            and chaos_ttft.get("breaching") is True
+            and (burn_gauge.get("value") or 0) > 1.0
+            and breach_event
+            and proc.returncode == 0
+            and report.get("completed") == 26
+            # the chaos requests are the slowest and their wall is the
+            # injected 0.8 s prefill sleep — the report must name the
+            # prefill side (the later of the two spends its wall
+            # WAITING for the other's chunk: same cause, "prefill_wait")
+            and slowest.get("dominant_phase") in ("prefill",
+                                                  "prefill_wait")
+            and (report.get("max_unattributed_frac") or 1.0) <= 0.05,
+        }
+    finally:
+        for v in ("SPARKDL_EVENT_DIR", "SPARKDL_SLO_TTFT_S",
+                  "SPARKDL_SLO_LATENCY_S", "SPARKDL_SLO_WINDOWS_S",
+                  "SPARKDL_METRICS_INTERVAL_S"):
+            os.environ.pop(v, None)
+
+
 def main() -> int:
     out_dir = tempfile.mkdtemp(prefix="sparkdl-obs-smoke-")
     event_dir = os.path.join(out_dir, "events")
@@ -250,7 +382,9 @@ def main() -> int:
                      and "UNAVAILABLE" in str(err))
     telemetry = _scoring_leg(out_dir)
     ingest = _ingest_leg(out_dir)
-    ok = postmortem_ok and telemetry["ok"] and ingest["ok"]
+    serving = _serving_leg(out_dir)
+    ok = postmortem_ok and telemetry["ok"] and ingest["ok"] \
+        and serving["ok"]
     print(json.dumps({
         "ok": ok,
         "postmortem_ok": postmortem_ok,
@@ -262,6 +396,7 @@ def main() -> int:
         "gang_timeline": merged_path,
         "telemetry": telemetry,
         "ingest": ingest,
+        "serving": serving,
         "out_dir": out_dir,
     }))
     return 0 if ok else 1

@@ -37,7 +37,7 @@ from typing import Iterable
 
 __all__ = ["intervals_from_events", "read_span_stream", "load_event_dir",
            "union_seconds", "analyze", "utilization_from_events",
-           "format_report"]
+           "format_report", "request_summary", "format_request_summary"]
 
 _EVENT_FILE_RE = re.compile(r"events_rank(\d+)\.jsonl$")
 # Span names that are not pipeline *stages*: whole-run envelopes whose
@@ -238,6 +238,142 @@ def utilization_from_events(events: Iterable[dict]) -> dict | None:
                            "count", "rows")}
                    for name, st in rep["stages"].items()},
     }
+
+
+def _pct(sorted_vals: list, q: float):
+    """Nearest-rank percentile of an ascending list (exact values —
+    offline trace analysis needs no bucket resolution)."""
+    if not sorted_vals:
+        return None
+    i = min(len(sorted_vals) - 1,
+            max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return round(sorted_vals[i], 6)
+
+
+def request_summary(events: Iterable[dict], top_n: int = 8,
+                    tail_frac: float = 0.01) -> dict | None:
+    """Request-trace tail analysis over a span stream (ISSUE 13): the
+    assembled per-request traces (``telemetry.assemble_request_traces``
+    — the same fold the live collector runs), exact latency/TTFT
+    percentiles, the slowest ``top_n`` with phase attribution, and the
+    **dominant cause of the p99 tail** — the phase holding the most
+    wall time across the slowest ``tail_frac`` of requests. None when
+    the stream holds no completed ``serve_*`` traces.
+
+    Also reports the attribution residual: ``max_unattributed_frac``
+    over completed (non-error) traces is the "phases provably sum to
+    measured latency" observable (the serve_bench acceptance bound is
+    0.05). When objectives are armed (``SPARKDL_SLO_*``), an ``slo``
+    compliance block is attached (exact per-trace values — the offline
+    twin of the live burn-rate monitor)."""
+    from . import slo, telemetry
+    col = telemetry.assemble_request_traces(events)
+    traces = col.traces()
+    if not traces:
+        return None
+    by_slow = sorted(traces, key=lambda t: -t["latency_s"])
+    lats = sorted(t["latency_s"] for t in traces)
+    ttfts = sorted(t["ttft_s"] for t in traces
+                   if t.get("ttft_s") is not None)
+    n_tail = max(1, int(round(len(traces) * tail_frac)))
+    tail = by_slow[:n_tail]
+    tail_phases: dict[str, float] = {}
+    for t in tail:
+        for k, v in (t.get("phases") or {}).items():
+            tail_phases[k] = tail_phases.get(k, 0.0) + v
+    tail_wall = sum(tail_phases.values()) or 1e-9
+    dominant = max(tail_phases, key=tail_phases.get) if tail_phases \
+        else None
+    complete = [t for t in traces
+                if t.get("finish") != "error" and not t.get("partial")
+                and t["latency_s"] > 0]
+    unattr = [abs(t["unattributed_s"]) / t["latency_s"]
+              for t in complete]
+    out = {
+        "completed": len(traces),
+        "errors": sum(1 for t in traces if t.get("finish") == "error"),
+        "open": col.open_count(),
+        "latency_s": {"p50": _pct(lats, 0.50), "p95": _pct(lats, 0.95),
+                      "p99": _pct(lats, 0.99),
+                      "max": round(lats[-1], 6)},
+        "ttft_s": {"p50": _pct(ttfts, 0.50), "p99": _pct(ttfts, 0.99)}
+        if ttfts else None,
+        "slowest": by_slow[:top_n],
+        "tail_n": n_tail,
+        "tail_dominant_phase": dominant,
+        "tail_phase_frac": {k: round(v / tail_wall, 4)
+                            for k, v in sorted(tail_phases.items())},
+        "max_unattributed_frac": round(max(unattr), 4) if unattr
+        else None,
+        "mean_unattributed_frac": round(sum(unattr) / len(unattr), 4)
+        if unattr else None,
+    }
+    slo_block = slo.compliance_from_traces(traces)
+    if slo_block:
+        out["slo"] = slo_block
+    return out
+
+
+def format_request_summary(req: dict) -> str:
+    """Human rendering shared by ``scripts/request_report.py`` and
+    ``scripts/bottleneck_report.py``: slowest-requests table with phase
+    attribution, the p99-tail dominant cause, and the SLO compliance
+    block when objectives are armed."""
+    lines = []
+    lat, ttft = req["latency_s"], req.get("ttft_s")
+    lines.append(
+        f"request traces: {req['completed']} completed "
+        f"({req['errors']} errors, {req['open']} still open) — latency "
+        f"p50 {lat['p50']}s p95 {lat['p95']}s p99 {lat['p99']}s "
+        f"max {lat['max']}s"
+        + (f"; TTFT p50 {ttft['p50']}s p99 {ttft['p99']}s" if ttft
+           else ""))
+    if req.get("max_unattributed_frac") is not None:
+        lines.append(
+            f"phase attribution residual: max "
+            f"{100 * req['max_unattributed_frac']:.1f}% of latency "
+            f"unattributed (mean "
+            f"{100 * req['mean_unattributed_frac']:.1f}%)")
+    cols = ("req", "latency_s", "queue", "prefill", "pf_wait",
+            "blk_stall", "draft", "decode", "unattr", "toks", "finish",
+            "dominant")
+    rows = []
+    for t in req["slowest"]:
+        ph = t.get("phases") or {}
+        rows.append((
+            str(t["request"]), f"{t['latency_s']:.4f}",
+            f"{ph.get('queue', 0):.4f}", f"{ph.get('prefill', 0):.4f}",
+            f"{ph.get('prefill_wait', 0):.4f}",
+            f"{ph.get('block_stall', 0):.4f}",
+            f"{ph.get('draft', 0):.4f}", f"{ph.get('decode', 0):.4f}",
+            f"{t['unattributed_s']:.4f}", str(t.get("tokens_out", 0)),
+            str(t.get("finish")), str(t.get("dominant_phase"))))
+    widths = [max(len(c), *(len(r[i]) for r in rows))
+              for i, c in enumerate(cols)]
+    lines.append("  ".join(c.ljust(widths[i])
+                           for i, c in enumerate(cols)))
+    lines += ["  ".join(v.ljust(widths[i]) for i, v in enumerate(r))
+              for r in rows]
+    if req.get("tail_dominant_phase"):
+        fr = req["tail_phase_frac"].get(req["tail_dominant_phase"], 0)
+        lines.append(
+            f"p99 tail (slowest {req['tail_n']} request(s)): dominant "
+            f"cause = {req['tail_dominant_phase']} "
+            f"({100 * fr:.1f}% of tail wall)")
+    slo_block = req.get("slo")
+    if slo_block:
+        lines.append("SLO compliance (whole stream, exact traces):")
+        for name, ob in sorted(slo_block.items()):
+            thr = ob.get("threshold_s", ob.get("max_error_rate"))
+            comp = ob.get("compliance")
+            lines.append(
+                f"  {name} (<= {thr}"
+                + ("s" if "threshold_s" in ob else " error rate")
+                + f", target {ob['target']}): compliance "
+                + (f"{comp:.4f}" if comp is not None else "n/a")
+                + (" — MET" if ob.get("met")
+                   else " — VIOLATED" if comp is not None else ""))
+    return "\n".join(lines)
 
 
 def format_report(rep: dict) -> str:

@@ -427,10 +427,15 @@ _MERGE_TAIL_BYTES = 1 << 20  # per-rank read cap when merging timelines
 # it causes on later attempts (`train_batch_skipped`), and a resume that
 # could not verify a data cursor (`unverified_data_cursor` — legacy
 # manifest or CRC mismatch: batches before the restored step re-consume).
+# ISSUE 13 adds the SLO monitor's breach transitions: a burn-rate breach
+# is service degradation the run survived — timeline narrative a
+# postmortem should show, never failure evidence that could outrank the
+# fault that actually killed the gang.
 _DEGRADATION_EVENTS = ("retry", "quarantine", "checkpoint_rollback",
                        "checkpoint_quarantine", "train_resume",
                        "train_batch_quarantined", "train_batch_skipped",
-                       "unverified_data_cursor")
+                       "unverified_data_cursor", "slo_breach",
+                       "slo_recovered")
 
 
 def atomic_write_json(path: str, obj) -> str:

@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import atexit
 import bisect
+import collections
 import json
 import logging
 import os
@@ -53,11 +54,13 @@ from . import events
 
 __all__ = [
     "METRICS_DIR_ENV", "METRICS_PORT_ENV", "METRICS_INTERVAL_ENV",
+    "TRACE_RING_ENV", "TRACE_SLOWEST_ENV",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "StageAccountant",
+    "RequestTraceCollector", "assemble_request_traces", "request_traces",
     "start", "stop", "enabled", "maybe_start_from_env", "registry",
     "accountant", "snapshot", "flush_snapshot", "render_prometheus",
     "aggregate_snapshots", "clear_rank_files", "stage_utilization_summary",
-    "server_port", "histogram_quantile",
+    "server_port", "histogram_quantile", "histogram_fraction_below",
 ]
 
 log = logging.getLogger("sparkdl_tpu.runner")
@@ -66,6 +69,15 @@ METRICS_DIR_ENV = "SPARKDL_METRICS_DIR"
 METRICS_PORT_ENV = "SPARKDL_METRICS_PORT"
 METRICS_INTERVAL_ENV = "SPARKDL_METRICS_INTERVAL_S"
 HISTORY_CAP_ENV = "SPARKDL_METRICS_MAX_MB"
+# ISSUE 13 — request-scoped tracing: the completed-trace ring bound and
+# how many slowest traces ride each exported snapshot (so the tail
+# evidence survives a SIGKILL via the atomic latest-snapshot file).
+TRACE_RING_ENV = "SPARKDL_TRACE_RING"
+TRACE_SLOWEST_ENV = "SPARKDL_TRACE_SLOWEST"
+_DEFAULT_TRACE_RING = 256
+_DEFAULT_TRACE_SLOWEST = 8
+_MAX_OPEN_TRACES = 4096  # in-flight fold states (queue+slots bound this
+# in practice; the cap is a leak guard against half-traced streams)
 _DEFAULT_INTERVAL_S = 2.0
 _DEFAULT_HISTORY_CAP_MB = 64  # per-rank .jsonl history cap; the atomic
 # latest-snapshot file keeps updating past it (same disk-safety rule as
@@ -205,6 +217,32 @@ def histogram_quantile(hist: dict, q: float) -> float | None:
             return round(prev_bound + width * max(0.0, frac), 9)
         prev_cum, prev_bound = cum, bound
     return float(bounds[-1])  # rank lands in +Inf: report the last edge
+
+
+def histogram_fraction_below(hist: dict, threshold: float
+                             ) -> float | None:
+    """Fraction of observations <= ``threshold`` in a cumulative-bucket
+    histogram snapshot, interpolated inside the bucket the threshold
+    falls in (the dual of :func:`histogram_quantile` — the SLO monitor's
+    compliance derivation). Observations past the last finite bound (the
+    implicit ``+Inf`` bucket) count as above any finite threshold.
+    Returns None for an empty histogram."""
+    count = int(hist.get("count") or 0)
+    bounds = list(hist.get("bounds") or [])
+    buckets = list(hist.get("buckets") or [])
+    if count <= 0 or not bounds or len(bounds) != len(buckets):
+        return None
+    threshold = float(threshold)
+    prev_cum, prev_bound = 0, 0.0
+    for bound, cum in zip(bounds, buckets):
+        if threshold < bound:
+            width = bound - prev_bound
+            frac = (threshold - prev_bound) / width if width > 0 else 1.0
+            good = prev_cum + (cum - prev_cum) * max(0.0, min(1.0, frac))
+            return round(good / count, 6)
+        prev_cum = cum
+        prev_bound = bound
+    return round(prev_cum / count, 6)  # threshold >= last finite bound
 
 
 class MetricsRegistry:
@@ -401,6 +439,241 @@ class StageAccountant:
 
 
 # ---------------------------------------------------------------------------
+# Request-scoped trace assembly (ISSUE 13, tentpole layer 1)
+# ---------------------------------------------------------------------------
+
+def _trace_ring_default() -> int:
+    try:
+        return max(8, int(os.environ.get(TRACE_RING_ENV,
+                                         _DEFAULT_TRACE_RING)))
+    except ValueError:
+        return _DEFAULT_TRACE_RING
+
+
+def _trace_slowest_default() -> int:
+    try:
+        return max(1, int(os.environ.get(TRACE_SLOWEST_ENV,
+                                         _DEFAULT_TRACE_SLOWEST)))
+    except ValueError:
+        return _DEFAULT_TRACE_SLOWEST
+
+
+class RequestTraceCollector:
+    """Folds the serving engine's per-request ``serve_*`` spans/events
+    into one trace record per request (ISSUE 13). Rides the same
+    ``events.add_tee`` seam as :class:`StageAccountant` — zero cost when
+    the plane is off (no tee registered), one dict fold per serving
+    event when armed.
+
+    The engine's per-request emissions carry ``request=<id>``:
+    ``serve_queue`` (one completed span per queued stint — its duration
+    is the stint's wait, its ``t - dur_s`` the enqueue time, so the
+    FIRST one pins ``t_submit``), ``serve_prefill`` (duration = active
+    prefill compute; ``wait_s`` = the PREFILLING phase's wall minus
+    that — time the chunked prefill sat waiting for its round-robin
+    turn; ``reused`` = prefix-cache tokens skipped), and
+    ``serve_decode`` at retirement (duration = the decode phase wall,
+    with ``draft_s`` / ``block_stall_s`` sub-phase attrs and the
+    per-request speculation ledger folded in). Retry/preempt/quarantine
+    point events tally counts; a quarantine finalizes the trace with
+    ``finish="error"``.
+
+    A completed trace's phases **provably sum to its measured
+    latency**: ``latency_s = t_done - t_submit`` and
+    ``unattributed_s = latency_s - (queue_s + prefill_s +
+    prefill_wait_s + decode_s)`` is carried explicitly (the serve_bench
+    acceptance bound is |unattributed| <= 5% of latency).
+    ``phases`` breaks the wall down one level further — ``draft`` and
+    ``block_stall`` are carved OUT of the decode wall, so the
+    ``dominant_phase`` names the actual cause ("queue", "prefill",
+    "prefill_wait", "block_stall", "draft", "decode", "unattributed").
+
+    Completed traces land in a bounded ring (``SPARKDL_TRACE_RING``,
+    default 256) and the slowest ``SPARKDL_TRACE_SLOWEST`` (default 8)
+    are kept sorted for the snapshot exporter — the tail evidence
+    survives SIGKILL via the atomic latest-snapshot file. Thread-safe.
+    """
+
+    def __init__(self, ring_size: int | None = None,
+                 slowest_n: int | None = None):
+        self._ring: collections.deque = collections.deque(
+            maxlen=ring_size if ring_size is not None
+            else _trace_ring_default())
+        self._slowest_n = slowest_n if slowest_n is not None \
+            else _trace_slowest_default()
+        self._slowest: list[dict] = []  # sorted desc by latency_s
+        self._open: dict = {}           # request id -> folding state
+        self._completed = 0
+        self._latency_sum = 0.0
+        self._lock = threading.Lock()
+
+    # -- tee callback -----------------------------------------------------
+    def on_event(self, rec: dict):
+        name = rec.get("name")
+        if not isinstance(name, str) or not name.startswith("serve_"):
+            return
+        rid = rec.get("request")
+        if rid is None:
+            return  # engine-scoped serve_* events carry no request id
+        ph = rec.get("ph")
+        t = rec.get("t")
+        t = float(t) if isinstance(t, (int, float)) else 0.0
+        dur = rec.get("dur_s")
+        dur = float(dur) if isinstance(dur, (int, float)) and dur > 0 \
+            else 0.0
+        with self._lock:
+            tr = self._open.get(rid)
+            if tr is None:
+                if len(self._open) >= _MAX_OPEN_TRACES:
+                    # leak guard for half-traced streams: drop the
+                    # stalest fold state (insertion order = age)
+                    self._open.pop(next(iter(self._open)))
+                tr = self._open[rid] = {
+                    "request": rid, "t_submit": None, "queue_s": 0.0,
+                    "prefill_s": 0.0, "prefill_wait_s": 0.0,
+                    "decode_s": 0.0, "draft_s": 0.0,
+                    "block_stall_s": 0.0, "tokens_out": 0,
+                    "reused_tokens": 0, "retries": 0, "preemptions": 0,
+                    "spec_windows": 0, "spec_drafted": 0,
+                    "spec_accepted": 0, "ttft_s": None}
+            if name == "serve_queue" and ph == "E":
+                tr["queue_s"] += dur
+                if tr["t_submit"] is None:
+                    tr["t_submit"] = t - dur
+            elif name == "serve_prefill" and ph == "E":
+                tr["prefill_s"] += dur
+                w = rec.get("wait_s")
+                if isinstance(w, (int, float)) and w > 0:
+                    tr["prefill_wait_s"] += float(w)
+                r = rec.get("reused")
+                if isinstance(r, (int, float)):
+                    tr["reused_tokens"] = max(tr["reused_tokens"], int(r))
+                if "error" not in rec and tr["ttft_s"] is None \
+                        and tr["t_submit"] is not None:
+                    # the first token is delivered at prefill completion
+                    tr["ttft_s"] = round(t - tr["t_submit"], 6)
+            elif name == "serve_decode" and ph == "E":
+                tr["decode_s"] += dur
+                for k in ("draft_s", "block_stall_s"):
+                    v = rec.get(k)
+                    if isinstance(v, (int, float)) and v > 0:
+                        tr[k] += float(v)
+                for k in ("spec_windows", "spec_drafted",
+                          "spec_accepted", "preemptions"):
+                    v = rec.get(k)
+                    if isinstance(v, (int, float)):
+                        tr[k] = int(v)
+                rows = rec.get("rows")
+                if isinstance(rows, (int, float)):
+                    tr["tokens_out"] = int(rows)
+                self._finalize(tr, t, str(rec.get("reason") or "done"))
+            elif name in ("serve_prefill_retry",
+                          "serve_prefill_chunk_retry",
+                          "serve_reserve_retry"):
+                tr["retries"] += 1
+            elif name == "serve_request_preempted":
+                tr["preemptions"] += 1
+                d = rec.get("decode_s")  # the aborted stint's decode wall
+                if isinstance(d, (int, float)) and d > 0:
+                    tr["decode_s"] += float(d)
+            elif name == "serve_request_quarantined":
+                self._finalize(tr, t, "error")
+
+    def _finalize(self, tr: dict, t_done: float, finish: str):
+        """Caller holds the lock: close the fold state into a completed
+        trace, append to the ring, update the slowest-N list."""
+        self._open.pop(tr["request"], None)
+        tr["finish"] = finish
+        attributed = (tr["queue_s"] + tr["prefill_s"]
+                      + tr["prefill_wait_s"] + tr["decode_s"])
+        if tr["t_submit"] is not None:
+            lat = max(0.0, t_done - tr["t_submit"])
+        else:
+            # ring/stream truncation ate the serve_queue span: the best
+            # honest latency is the attributed time, flagged partial
+            lat = attributed
+            tr["partial"] = True
+        tr["latency_s"] = round(lat, 6)
+        tr["unattributed_s"] = round(lat - attributed, 6)
+        tr["t_done"] = round(t_done, 6)
+        if tr["t_submit"] is not None:
+            tr["t_submit"] = round(tr["t_submit"], 6)
+        if tr["spec_windows"] > 0:
+            # committed tokens per verify window = accepted drafts + the
+            # target's own token — the mean accept length observable
+            tr["spec_mean_accept_len"] = round(
+                (tr["spec_accepted"] + tr["spec_windows"])
+                / tr["spec_windows"], 3)
+        decode_compute = max(
+            0.0, tr["decode_s"] - tr["draft_s"] - tr["block_stall_s"])
+        phases = {
+            "queue": tr["queue_s"], "prefill": tr["prefill_s"],
+            "prefill_wait": tr["prefill_wait_s"],
+            "block_stall": tr["block_stall_s"], "draft": tr["draft_s"],
+            "decode": decode_compute,
+            "unattributed": max(0.0, tr["unattributed_s"]),
+        }
+        tr["phases"] = {k: round(v, 6) for k, v in phases.items()}
+        tr["dominant_phase"] = max(phases, key=phases.get)
+        for k in ("queue_s", "prefill_s", "prefill_wait_s", "decode_s",
+                  "draft_s", "block_stall_s"):
+            tr[k] = round(tr[k], 6)
+        self._completed += 1
+        self._latency_sum += lat
+        self._ring.append(tr)
+        s = self._slowest
+        s.append(tr)
+        s.sort(key=lambda x: -x["latency_s"])
+        del s[self._slowest_n:]
+
+    # -- views ------------------------------------------------------------
+    def traces(self) -> list[dict]:
+        """Completed traces still in the ring, oldest first."""
+        with self._lock:
+            return [dict(t) for t in self._ring]
+
+    def slowest(self) -> list[dict]:
+        """The slowest completed traces seen (ever — not ring-bounded),
+        highest latency first."""
+        with self._lock:
+            return [dict(t) for t in self._slowest]
+
+    def open_count(self) -> int:
+        with self._lock:
+            return len(self._open)
+
+    def summary(self) -> dict | None:
+        """The ``request_traces`` snapshot block: counts + the slowest-N
+        traces (these survive SIGKILL via the exporter's atomic
+        latest-snapshot file). None when nothing serving-shaped has been
+        seen — non-serving snapshots stay clean."""
+        with self._lock:
+            if not self._completed and not self._open:
+                return None
+            return {
+                "completed": self._completed,
+                "open": len(self._open),
+                "in_ring": len(self._ring),
+                "latency_sum_s": round(self._latency_sum, 6),
+                "slowest": [dict(t) for t in self._slowest],
+            }
+
+
+def assemble_request_traces(records, ring_size: int = 1_000_000
+                            ) -> RequestTraceCollector:
+    """Offline trace assembly: run a span stream (e.g.
+    ``analysis.load_event_dir``) through a fresh collector and return
+    it. Records are time-sorted first so multi-rank merges fold in
+    emission order. This is THE one fold implementation — the live tee
+    and ``scripts/request_report.py`` cannot drift apart."""
+    col = RequestTraceCollector(ring_size=ring_size, slowest_n=64)
+    for rec in sorted(records, key=lambda r: r.get("t", 0.0)
+                      if isinstance(r.get("t"), (int, float)) else 0.0):
+        col.on_event(rec)
+    return col
+
+
+# ---------------------------------------------------------------------------
 # The process-global plane
 # ---------------------------------------------------------------------------
 
@@ -412,6 +685,7 @@ class _Plane:
     def __init__(self):
         self.registry = MetricsRegistry()
         self.accountant = StageAccountant()
+        self.traces = RequestTraceCollector()
         self.metrics_dir: str | None = None
         self.port: int | None = None
         self._stop = threading.Event()
@@ -437,6 +711,24 @@ class _Plane:
         for k in ("counters", "gauges", "histograms"):
             if reg[k]:
                 snap[k] = reg[k]
+        traces = self.traces.summary()
+        if traces:
+            snap["request_traces"] = traces
+        # SLO evaluation rides the snapshot cadence (every exporter
+        # tick + the boundary flushes, INCLUDING stop()'s final flush,
+        # which runs after _started drops): the monitor diffs this
+        # snapshot's cumulative histograms/counters against its window
+        # history. It self-gates — armed only by SPARKDL_SLO_* env
+        # knobs (unarmed = one cached-global read), and its gauges gate
+        # on telemetry.enabled(), so the off-plane zero-registration
+        # pin holds either way.
+        try:
+            from . import slo
+            block = slo.evaluate(snap)
+            if block:
+                snap["slo"] = block
+        except Exception:  # noqa: BLE001 — telemetry must never
+            pass           # kill the exporter or a boundary flush
         return snap
 
     def write_snapshot(self) -> str | None:
@@ -505,6 +797,7 @@ class _Plane:
             self._history_bytes = None   # re-seed from the (possibly
             self._history_capped = False  # new) dir's on-disk state
             events.add_tee(self.accountant.on_event)
+            events.add_tee(self.traces.on_event)
             if metrics_dir:
                 self._stop.clear()
                 self._thread = threading.Thread(
@@ -530,6 +823,22 @@ class _Plane:
                     elif self.path.startswith("/metrics"):
                         body = render_prometheus(plane.snapshot()).encode()
                         ctype = "text/plain; version=0.0.4"
+                    elif self.path.startswith("/serving"):
+                        # Live engine inspector (ISSUE 13): every
+                        # registered GenerationEngine's slot table /
+                        # queue / KV pool / speculation state, mid-run.
+                        # Same degrade-never-kill posture as the rest of
+                        # the plane: an inspector failure answers as an
+                        # error body, never takes the endpoint down.
+                        try:
+                            from ..serving import introspect
+                            body = json.dumps(introspect.serving_snapshot(),
+                                              default=str).encode()
+                        except Exception as e:  # noqa: BLE001
+                            body = json.dumps(
+                                {"error":
+                                 f"{type(e).__name__}: {e}"[:300]}).encode()
+                        ctype = "application/json"
                     else:
                         self.send_error(404)
                         return
@@ -562,6 +871,7 @@ class _Plane:
                 return
             self._started = False
             events.remove_tee(self.accountant.on_event)
+            events.remove_tee(self.traces.on_event)
             self._stop.set()
             t, self._thread = self._thread, None
         if t is not None:
@@ -603,6 +913,13 @@ def registry() -> MetricsRegistry:
 
 def accountant() -> StageAccountant:
     return _get_plane().accountant
+
+
+def request_traces() -> RequestTraceCollector:
+    """The plane's live request-trace collector (ISSUE 13). It only
+    observes events while the plane is armed — with the plane off the
+    tee is never registered and the collector stays empty."""
+    return _get_plane().traces
 
 
 def server_port() -> int | None:
@@ -820,7 +1137,13 @@ def aggregate_snapshots(metrics_dir: str) -> dict | None:
     counters: dict[str, float] = {}
     gauges: dict[str, dict] = {}
     histograms: dict[str, dict] = {}
+    traces = {"completed": 0, "open": 0, "slowest": []}
     for snap in ranks.values():
+        tb = snap.get("request_traces") or {}
+        if tb:
+            traces["completed"] += int(tb.get("completed") or 0)
+            traces["open"] += int(tb.get("open") or 0)
+            traces["slowest"].extend(tb.get("slowest") or [])
         for name, st in (snap.get("stages") or {}).items():
             agg = stages.setdefault(name, {
                 "count": 0, "busy_s": 0.0, "wall_busy_s": 0.0, "rows": 0,
@@ -875,6 +1198,14 @@ def aggregate_snapshots(metrics_dir: str) -> dict | None:
         out["gauges"] = gauges
     if histograms:
         out["histograms"] = histograms
+    if traces["completed"] or traces["open"]:
+        # gang view of the request-trace tail: slowest across ranks,
+        # re-ranked to the same SPARKDL_TRACE_SLOWEST bound each rank's
+        # export honors
+        traces["slowest"].sort(
+            key=lambda t: -(t.get("latency_s") or 0.0))
+        del traces["slowest"][_trace_slowest_default():]
+        out["request_traces"] = traces
     return out
 
 

@@ -8,10 +8,12 @@ lazily by :meth:`GenerationEngine.from_model`.
 
 from .draft import (DraftModelProvider, HistoryDraft, NGramDraft,
                     make_provider)
-from .engine import (PREFILLING, EngineStopped, GenerationEngine,
-                     QueueFullError, Request, RequestQuarantined,
-                     RequestRejected, ServingError, ServingStallError,
-                     StubBackend, bucket_length)
+from .engine import (ENGINE_SCOPED_EVENTS, PREFILLING,
+                     REQUEST_SCOPED_EVENTS, EngineStopped,
+                     GenerationEngine, QueueFullError, Request,
+                     RequestQuarantined, RequestRejected, ServingError,
+                     ServingStallError, StubBackend, bucket_length)
+from .introspect import engine_debug_state, serving_snapshot
 from .paging import (BlockAllocator, BlockError, BlockExhausted,
                      PagedBlockManager)
 from .prefix import PrefixCache, RadixPrefixCache
@@ -23,4 +25,6 @@ __all__ = [
     "PREFILLING", "PrefixCache", "RadixPrefixCache", "BlockAllocator",
     "BlockError", "BlockExhausted", "PagedBlockManager", "NGramDraft",
     "HistoryDraft", "DraftModelProvider", "make_provider",
+    "REQUEST_SCOPED_EVENTS", "ENGINE_SCOPED_EVENTS",
+    "engine_debug_state", "serving_snapshot",
 ]

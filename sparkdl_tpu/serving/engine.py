@@ -107,13 +107,15 @@ import threading
 import time
 
 from ..runner import events, telemetry
+from .introspect import register_engine
 from .paging import BlockExhausted
 
 __all__ = [
     "GenerationEngine", "Request", "StubBackend", "bucket_length",
     "ServingError", "RequestRejected", "QueueFullError",
     "RequestQuarantined", "ServingStallError", "EngineStopped",
-    "PREFILLING", "BlockExhausted",
+    "PREFILLING", "BlockExhausted", "REQUEST_SCOPED_EVENTS",
+    "ENGINE_SCOPED_EVENTS",
 ]
 
 log = logging.getLogger("sparkdl_tpu.serving")
@@ -219,6 +221,28 @@ def bucket_length(prompt_len: int, min_bucket: int = _DEFAULT_MIN_BUCKET
     return b
 
 
+# Every serve_* span/event the engine emits is classified here (ISSUE
+# 13): REQUEST-scoped emissions carry ``request=<id>`` — the trace
+# collector folds them into per-request records and SILENTLY degrades
+# for any that drop the attribution, so a drift-guard test pins that
+# (a) any serve_* name the engine emits appears in exactly one of
+# these sets and (b) every REQUEST-scoped record carries ``request=``.
+# ENGINE-scoped emissions describe the engine as a whole (a rejection
+# happens before a Request exists; a step retry is not attributable to
+# one request until eviction names a suspect; stall/draft spans cover
+# all slots of an iteration).
+REQUEST_SCOPED_EVENTS = frozenset({
+    "serve_queue", "serve_prefill", "serve_decode",
+    "serve_prefill_retry", "serve_prefill_chunk_retry",
+    "serve_reserve_retry", "serve_prefix_seed_failed",
+    "serve_request_quarantined", "serve_request_preempted",
+    "serve_admission_block_wait",
+})
+ENGINE_SCOPED_EVENTS = frozenset({
+    "serve_reject", "serve_step_retry", "serve_decode_stall",
+    "serve_draft", "serve_engine_fatal",
+})
+
 # Request lifecycle states (plain strings — they serialize into events
 # and stats as-is). PREFILLING is the stall-free scheduler's state: the
 # request owns a slot and its prompt is being consumed chunk by chunk,
@@ -271,6 +295,18 @@ class Request:
         self.preemptions = 0
         self.served_len = len(self.prompt)
         self._block_stalled = False
+        # request-scoped phase ledger (ISSUE 13): the trace collector
+        # reads these off the serve_decode span at retirement —
+        # t_enqueue starts the CURRENT queued stint (reset on requeue,
+        # so a preempted request's serve_queue spans each measure their
+        # own wait instead of everything since submit)
+        self.t_enqueue = self.t_submit
+        self.draft_s = 0.0
+        self.block_stall_s = 0.0
+        self.spec_windows = 0
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self._block_stall_t0: float | None = None
         self._done = threading.Event()
 
     # -- caller-side API --------------------------------------------------
@@ -601,6 +637,10 @@ class GenerationEngine:
             "spec_verifies": 0, "spec_tokens_accepted": 0,
             "spec_tokens_rejected": 0,
         }
+        # Live inspector (ISSUE 13): one weak-set add per engine BUILD
+        # (never per token); /serving on the telemetry HTTP server
+        # snapshots every registered engine via debug_state().
+        register_engine(self)
 
     # -- construction -----------------------------------------------------
     @classmethod
@@ -974,7 +1014,11 @@ class GenerationEngine:
         req.t_admit = time.time()
         req.slot = slot
         self._metric("gauge", "serving_queue_depth", depth)
-        wait_s = req.t_admit - req.t_submit
+        # Per-STINT wait: t_enqueue is reset on every requeue, so a
+        # preempted request's second serve_queue span measures only its
+        # re-queued wait — the trace collector sums stints, and phases
+        # still total the end-to-end latency.
+        wait_s = req.t_admit - req.t_enqueue
         events.completed_span("serve_queue", wait_s, request=req.id)
         self._metric("histogram", "serving_queue_wait_s", wait_s)
         return req, slot
@@ -1016,6 +1060,7 @@ class GenerationEngine:
             self._work.notify_all()
         self._release_slot(slot)
         req.slot = None
+        req.t_enqueue = time.time()  # new queued stint begins
         self.stats["admission_block_waits"] += 1
         events.event("serve_admission_block_wait", request=req.id)
 
@@ -1037,6 +1082,11 @@ class GenerationEngine:
     def _arm_chunked_prefill(self, req: Request, slot: int) -> bool:
         c = self.prefill_chunk
         served = self._served_prompt(req)
+        # Per-stint active-prefill ledger: a preemption-resume re-arms
+        # here, and its serve_prefill span must report THIS stint's
+        # compute, not re-bill the previous stint's (already landed on
+        # the earlier span).
+        req.prefill_spent_s = 0.0
         with self._lock:
             n_running = sum(1 for r in self._slots
                             if r is not None and r.state == RUNNING)
@@ -1192,7 +1242,10 @@ class GenerationEngine:
             if getattr(e, "serving_fatal", False):
                 self._handle_fatal(e)
                 raise
-            self._note_stall(time.perf_counter() - t0, n_running)
+            dt_fail = time.perf_counter() - t0
+            self._note_stall(dt_fail, n_running)
+            req.prefill_spent_s += dt_fail  # failed-attempt compute is
+            # still prefill-phase time — it must not leak into wait_s
             req.failures += 1
             if req.failures > self.retries:
                 with self._work:
@@ -1225,10 +1278,19 @@ class GenerationEngine:
             req.state = RUNNING
             req.write_pos = req.served_len  # decode writes from L
             req.t_decode_start = time.time()
+            # wait_s = the PREFILLING phase's wall minus its active
+            # compute: time this request's chunks sat waiting for their
+            # round-robin turn while other slots prefilled/decoded. The
+            # trace collector needs it so queue + prefill + wait +
+            # decode provably sums to the measured latency.
+            phase_wall = req.t_decode_start - (req.t_admit
+                                               or req.t_decode_start)
+            wait_s = max(0.0, phase_wall - req.prefill_spent_s)
             events.completed_span(
                 "serve_prefill", req.prefill_spent_s, request=req.id,
                 slot=req.slot, bucket=req.bucket, rows=1,
-                chunks=len(req.chunk_plan), reused=req.prefill_reused)
+                chunks=len(req.chunk_plan), reused=req.prefill_reused,
+                wait_s=round(wait_s, 6))
             self._deliver(req, int(tok))
 
     def _prefill_with_retries(self, req: Request, slot: int) -> bool:
@@ -1395,6 +1457,7 @@ class GenerationEngine:
                       self.backend.max_len - req.write_pos - 1)
             d: list[int] = []
             if cap > 0:
+                t_d = time.perf_counter()
                 try:
                     d = [int(t) for t in self._draft.propose(
                         req.prompt + req.tokens, cap)][:cap]
@@ -1404,6 +1467,7 @@ class GenerationEngine:
                     log.exception("draft provider failed (request %s)",
                                   req.id)
                     d = []
+                req.draft_s += time.perf_counter() - t_d
             if self.paged and d:
                 ok = 0
                 for i in range(len(d)):
@@ -1441,6 +1505,9 @@ class GenerationEngine:
             self.stats["spec_tokens_accepted"] += a
             self.stats["spec_tokens_rejected"] += len(d) - a
             if d:
+                req.spec_windows += 1
+                req.spec_drafted += len(d)
+                req.spec_accepted += a
                 self._metric("counter", "serving_spec_tokens_accepted",
                              a)
                 self._metric("counter", "serving_spec_tokens_rejected",
@@ -1476,12 +1543,16 @@ class GenerationEngine:
         ordered = sorted(active,
                          key=lambda sr: (sr[1].t_admit or 0.0, sr[1].id))
         ok, stalled = [], []
+        now = time.perf_counter()
         for slot, req in ordered:
             req._block_stalled = False
             if self.backend.ensure_block_for(slot, req.write_pos):
+                self._end_block_stall(req, now)
                 ok.append((slot, req))
             else:
                 req._block_stalled = True
+                if req._block_stall_t0 is None:
+                    req._block_stall_t0 = now  # stall interval opens
                 stalled.append((slot, req))
                 self.stats["block_stall_events"] += 1
         if stalled and not ok:
@@ -1493,8 +1564,18 @@ class GenerationEngine:
                     continue
                 if self.backend.ensure_block_for(slot, req.write_pos):
                     req._block_stalled = False
+                    self._end_block_stall(req, time.perf_counter())
                     ok.append((slot, req))
         return sorted(ok)
+
+    @staticmethod
+    def _end_block_stall(req: Request, now: float):
+        """Close an open block-stall interval into the request's phase
+        ledger (the trace collector reads the total off the retirement
+        span)."""
+        if req._block_stall_t0 is not None:
+            req.block_stall_s += max(0.0, now - req._block_stall_t0)
+            req._block_stall_t0 = None
 
     def _preempt_newest(self, stalled) -> Request:
         """Deadlock breaker: requeue (front, FIFO-fair) the NEWEST
@@ -1511,14 +1592,23 @@ class GenerationEngine:
             self._queue.appendleft(victim)
             self._work.notify_all()
         self._release_slot(slot)
+        now = time.time()
+        self._end_block_stall(victim, time.perf_counter())
+        # the aborted stint's decode-phase wall: without it the trace
+        # collector would book this time as unattributed (the final
+        # serve_decode span only covers the LAST stint)
+        stint_decode_s = max(0.0, now - getattr(victim, "t_decode_start",
+                                                now))
         victim.slot = None
         victim.state = QUEUED
         victim.chunk_plan = None
         victim._block_stalled = False
         victim.preemptions += 1
+        victim.t_enqueue = now  # new queued stint begins
         self.stats["preemptions"] += 1
         events.event("serve_request_preempted", request=victim.id,
-                     generated=len(victim.tokens))
+                     generated=len(victim.tokens),
+                     decode_s=round(stint_decode_s, 6))
         self._metric("counter", "serving_requests_preempted_total")
         return victim
 
@@ -1578,8 +1668,26 @@ class GenerationEngine:
         req.t_done = time.time()
         self.stats["completed"] += 1
         decode_s = req.t_done - getattr(req, "t_decode_start", req.t_admit)
-        events.completed_span("serve_decode", decode_s, request=req.id,
-                              rows=len(req.tokens), reason=reason)
+        # Retirement span = the request's decode-phase wall, carrying
+        # the per-request sub-phase ledger (ISSUE 13): draft/block-stall
+        # seconds are carved out of the decode wall by the trace
+        # collector, the speculation counters yield its mean accept
+        # length. Only nonzero fields ride, keeping the stream lean.
+        attrs: dict = {"request": req.id, "rows": len(req.tokens),
+                       "reason": reason}
+        if req.prefill_reused:
+            attrs["reused"] = req.prefill_reused
+        if req.draft_s > 0:
+            attrs["draft_s"] = round(req.draft_s, 6)
+        if req.block_stall_s > 0:
+            attrs["block_stall_s"] = round(req.block_stall_s, 6)
+        if req.spec_windows:
+            attrs["spec_windows"] = req.spec_windows
+            attrs["spec_drafted"] = req.spec_drafted
+            attrs["spec_accepted"] = req.spec_accepted
+        if req.preemptions:
+            attrs["preemptions"] = req.preemptions
+        events.completed_span("serve_decode", decode_s, **attrs)
         self._metric("counter", "serving_requests_completed_total")
         self._metric("histogram", "serving_request_latency_s",
                      req.t_done - req.t_submit)
@@ -1648,6 +1756,16 @@ class GenerationEngine:
             req._done.set()
 
     # -- introspection ----------------------------------------------------
+    def debug_state(self) -> dict:
+        """Live operator view (ISSUE 13): the slot table (state /
+        request / write frontier / age / per-slot KV block footprint),
+        queue depth + head age, KV pool and radix residency, and
+        speculation acceptance — what ``/serving`` on the telemetry
+        HTTP server returns per engine. See
+        :func:`serving.introspect.engine_debug_state`."""
+        from .introspect import engine_debug_state
+        return engine_debug_state(self)
+
     def snapshot(self) -> dict:
         with self._lock:
             snap = {

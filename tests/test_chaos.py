@@ -297,10 +297,14 @@ def test_obs_smoke_script(tmp_path):
     streamed-scoring run with the live telemetry plane armed — a
     snapshot file must appear MID-run and the bottleneck report must
     name the expected host-side stage (decode) with internally
-    consistent busy fractions; finally a REAL image-scoring run whose
+    consistent busy fractions; then a REAL image-scoring run whose
     Arrow decode was the pre-ISSUE-7 bottleneck — post-PR the report
     must NOT name decode dominant (the fused zero-copy feed collapsed
-    it)."""
+    it); finally the ISSUE 13 serving leg — a stub engine under load
+    with the plane armed: /serving answers with a live slot map
+    MID-run, request_report.py names the slowest request's dominant
+    phase, healthy SLO compliance >= 0.99, and an injected-slowness
+    leg flips the burn-rate gauge."""
     proc = subprocess.run(
         [sys.executable, os.path.join(_REPO, "scripts", "obs_smoke.py")],
         capture_output=True, text=True, timeout=420,
@@ -315,6 +319,14 @@ def test_obs_smoke_script(tmp_path):
     assert tele["dominant_stage"] == "decode"
     assert tele["busy_fracs_consistent"] is True
     assert tele["max_speedup_fixing_others"] >= 1.0
+    serving = rec["serving"]
+    assert serving["serving_endpoint_live_mid_run"] is True
+    assert serving["healthy_ttft_compliance"] >= 0.99
+    assert serving["chaos_breaching"] is True
+    assert serving["burn_gauge_value"] > 1.0
+    assert serving["slowest_dominant_phase"] in ("prefill",
+                                                 "prefill_wait")
+    assert serving["max_unattributed_frac"] <= 0.05
 
 
 class TestCorruptKind:
