@@ -1178,6 +1178,22 @@ def _serve_headline(serve: dict) -> dict:
                       "serve_spec_mean_accept_len")):
         if spec.get(src) is not None:
             out[dst] = spec[src]
+    # ISSUE 14: tensor-parallel headline — greedy identity across the
+    # tp degrees, per-device KV pool bytes (the 1/tp shrink), and
+    # zero-re-trace evidence, from the 8-virtual-device subprocess leg
+    # (semantics/economics only — see the leg's honest_label).
+    tp = serve.get("tp") or {}
+    if tp.get("tp_identical") is not None:
+        out["serve_tp_identical"] = tp["tp_identical"]
+    if tp.get("kv_pool_device_bytes"):
+        out["serve_tp_kv_pool_device_bytes"] = tp["kv_pool_device_bytes"]
+    if tp.get("kv_pool_device_frac"):
+        out["serve_tp_kv_pool_device_frac"] = tp["kv_pool_device_frac"]
+    retr = [leg.get("decode_retrace_after_warmup", 0)
+            + leg.get("verify_retrace_after_warmup", 0)
+            for leg in (tp.get("degrees") or {}).values()]
+    if retr:
+        out["serve_tp_retraces_after_warmup"] = sum(retr)
     return out
 
 

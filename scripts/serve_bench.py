@@ -822,6 +822,154 @@ def run_spec_comparison_llama(n_requests: int = 48, num_slots: int = 2,
     return rec
 
 
+# ---------------------------------------------------------------------------
+# tensor-parallel leg (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+_TP_DEGREES = (1, 2, 4)
+_TP_HONEST_LABEL = (
+    "8 virtual CPU devices: validates multi-chip SEMANTICS (token "
+    "identity, zero re-traces, 1/tp per-device KV bytes) and re-trace/"
+    "memory economics — NOT wall-clock speedup; ICI-real tokens/s "
+    "needs the TPU backend")
+
+
+def _tp_config():
+    """TP-leg model: tiny (the leg measures semantics, not throughput —
+    see the honest label) with num_kv_heads == 4 so the head-sharded
+    KV layout is exact at every measured degree (tp must divide the KV
+    head count)."""
+    from sparkdl_tpu.models.llama import LlamaConfig
+    return LlamaConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                       num_heads=4, num_kv_heads=4,
+                       intermediate_size=256, rope_theta=10000.0)
+
+
+def make_tp_workload(n: int, vocab: int, seed: int = 11):
+    """Composition mix for the tp identity drive: every prompt opens
+    with a shared 16-token head (2 radix blocks at block_size 8 — the
+    graft path), bodies are short repeated phrases (the n-gram
+    self-drafting regime, so the speculative verify path runs on
+    real drafts), outputs 8."""
+    rng = np.random.RandomState(seed)
+    head = rng.randint(0, vocab, 16).tolist()
+    phrases = [rng.randint(0, vocab, 4).tolist() for _ in range(4)]
+    out = []
+    for _ in range(n):
+        body = (phrases[rng.randint(len(phrases))] * 3)[:rng.randint(3, 12)]
+        out.append((head + body, 8))
+    return out
+
+
+def _run_tp_worker(degrees, n_requests: int) -> dict:
+    """The in-subprocess half of the tp leg (the parent spawned us with
+    XLA_FLAGS forcing 8 virtual CPU devices — jax must not have
+    initialized a backend before this runs): for each tp degree, the
+    SAME paged + chunked-prefill + speculative engine config over the
+    same workload — greedy streams must be identical across degrees,
+    decode/verify must never re-trace after warmup, and per-device KV
+    pool bytes must shrink to ~1/tp."""
+    import jax
+
+    from sparkdl_tpu.core.runtime import GLOBAL_COMPILE_CACHE
+    from sparkdl_tpu.models import llama as L
+    from sparkdl_tpu.serving import GenerationEngine
+
+    cfg = _tp_config()
+    model = L.LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           np.zeros((1, 4), np.int32))
+    workload = make_tp_workload(n_requests, cfg.vocab_size)
+    degrees = [d for d in degrees if d <= len(jax.devices())]
+
+    def make_engine(tp: int):
+        return GenerationEngine.from_model(
+            model, variables, num_slots=4, max_len=64, prefill_chunk=8,
+            block_size=8, prefill_budget=16, spec_k=3, tp=tp,
+            queue_capacity=max(64, n_requests))
+
+    rec: dict = {"mode": "tp", "n_devices": len(jax.devices()),
+                 "platform": jax.default_backend(),
+                 "honest_label": _TP_HONEST_LABEL,
+                 "degrees": {}, "requests": n_requests}
+    streams: dict = {}
+    for tp in degrees:
+        # identity drive: sequential (drained) — per-request streams
+        # are scheduler-order-free evidence
+        eng = make_engine(tp)
+        hs = [eng.submit(p, max_new_tokens=n) for p, n in workload[:8]]
+        eng.run_until_idle()
+        streams[tp] = [h.result(1) for h in hs]
+        sig_d = GLOBAL_COMPILE_CACHE.signatures("serve_decode_step")
+        sig_v = GLOBAL_COMPILE_CACHE.signatures("serve_verify_step")
+        leg = run_engine_leg(lambda tp=tp: make_engine(tp),
+                             workload, concurrency=4)
+        leg["kv_pool_device_bytes"] = eng.kv_pool_device_bytes
+        leg["tp_degree"] = tp
+        leg["decode_retrace_after_warmup"] = (
+            GLOBAL_COMPILE_CACHE.signatures("serve_decode_step") - sig_d)
+        leg["verify_retrace_after_warmup"] = (
+            GLOBAL_COMPILE_CACHE.signatures("serve_verify_step") - sig_v)
+        rec["degrees"][str(tp)] = leg
+    # anchor on the first MEASURED degree (a BENCH_TP_DEGREES without
+    # tp=1 must still record cross-degree identity, not drop it); ONE
+    # measured degree is no cross-degree evidence at all — report None,
+    # never a vacuous True (an operator-pinned device_count=1 flag can
+    # filter the list down to a single degree)
+    rec["measured_degrees"] = list(degrees)
+    if len(streams) >= 2:
+        base = streams[degrees[0]]
+        rec["tp_identical"] = all(s == base for s in streams.values())
+    else:
+        rec["tp_identical"] = None
+    rec["kv_pool_device_bytes"] = {
+        str(tp): rec["degrees"][str(tp)]["kv_pool_device_bytes"]
+        for tp in degrees}
+    b1 = rec["kv_pool_device_bytes"].get("1")
+    if b1:
+        rec["kv_pool_device_frac"] = {
+            str(tp): round(rec["kv_pool_device_bytes"][str(tp)] / b1, 4)
+            for tp in degrees}
+    return rec
+
+
+def run_tp_comparison(n_requests: int = 24,
+                      degrees=_TP_DEGREES,
+                      timeout_s: float = 900.0) -> dict:
+    """ISSUE 14 tp leg — ALWAYS a fresh subprocess: the 8-virtual-device
+    CPU mesh must be forced before jax initializes a backend, which the
+    parent (possibly already holding a TPU or a 1-device CPU backend)
+    cannot do in-process. Runs in both healthy and backend_unavailable
+    bench records (the never-host-blind rule): the semantics it proves
+    are device-count economics, not wall-clock."""
+    import subprocess
+
+    from sparkdl_tpu.runner.launcher import host_device_flags
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = host_device_flags(env.get("XLA_FLAGS", ""), 8)
+    env["JAX_PLATFORMS"] = "cpu"
+    # Evidence hygiene (shared with tp_serving_record.py and the
+    # dryrun leg): ambient serving knobs must not reshape the leg —
+    # see scrub_serving_env's docstring for why KV_POOL_MB in
+    # particular would invert the 1/tp observable.
+    from sparkdl_tpu.serving.engine import scrub_serving_env
+    scrub_serving_env(env)
+    args = [sys.executable, os.path.abspath(__file__), "--tp-worker",
+            "--requests", str(n_requests),
+            "--degrees", ",".join(str(d) for d in degrees)]
+    out = subprocess.run(args, env=env, capture_output=True, text=True,
+                         timeout=timeout_s)
+    if out.returncode != 0:
+        return {"mode": "tp", "error":
+                (out.stderr or out.stdout or "")[-500:]}
+    # last line of stdout is the JSON record (warnings may precede it)
+    for line in reversed(out.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    return {"mode": "tp", "error": "no JSON in tp worker output"}
+
+
 def run_stub_scheduler_comparison(n_requests: int = 96,
                                   num_slots: int = 8,
                                   step_s: float = 0.002,
@@ -875,6 +1023,19 @@ def run(mode: str = "llama", rows: int | None = None) -> dict:
                     n_requests=min(48, max(16, n)))
         except Exception as e:  # noqa: BLE001 — the main legs stand
             rec["spec_error"] = f"{type(e).__name__}: {e}"[:300]
+    # ISSUE 14 tensor-parallel leg: a fresh subprocess on the forced
+    # 8-virtual-device CPU mesh (tp in {1,2,4}) — identity, re-trace
+    # and per-device-KV-bytes semantics ride BOTH the healthy llama
+    # record and the outage stub record (never-host-blind; the honest
+    # label in the leg states what virtual devices do NOT measure).
+    if not os.environ.get("BENCH_SKIP_TP"):
+        try:
+            rec["tp"] = run_tp_comparison(
+                n_requests=int(os.environ.get("BENCH_TP_REQUESTS", "24")),
+                degrees=tuple(int(d) for d in os.environ.get(
+                    "BENCH_TP_DEGREES", "1,2,4").split(",") if d))
+        except Exception as e:  # noqa: BLE001 — the main legs stand
+            rec["tp_error"] = f"{type(e).__name__}: {e}"[:300]
     return rec
 
 
@@ -883,7 +1044,29 @@ def main(argv=None) -> int:
     ap.add_argument("--stub", action="store_true",
                     help="jax-free scheduler-only run (StubBackend)")
     ap.add_argument("--requests", type=int, default=None)
+    ap.add_argument("--tp", action="store_true",
+                    help="tensor-parallel leg only (spawns the "
+                         "8-virtual-device subprocess)")
+    ap.add_argument("--tp-worker", action="store_true",
+                    help=argparse.SUPPRESS)  # internal: inside the
+    # forced-virtual-device subprocess run_tp_comparison spawned
+    ap.add_argument("--degrees", default=None, help=argparse.SUPPRESS)
     ns = ap.parse_args(argv)
+    if ns.tp_worker:
+        # The parent set XLA_FLAGS/JAX_PLATFORMS in our env; latch the
+        # platform before any backend initializes (the sitecustomize
+        # pre-imports jax, so go through jax.config like conftest.py).
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        degrees = tuple(int(d) for d in (ns.degrees or "1,2,4").split(",")
+                        if d)
+        rec = _run_tp_worker(degrees, ns.requests or 24)
+        print(json.dumps(rec))  # one line — the parent parses the tail
+        return 0
+    if ns.tp:
+        print(json.dumps(run_tp_comparison(
+            n_requests=ns.requests or 24), indent=2))
+        return 0
     if not ns.stub:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
     rec = run(mode="stub" if ns.stub else "llama", rows=ns.requests)

@@ -454,16 +454,33 @@ class LlamaModel(nn.Module):
 # BASELINE config 5)
 # ---------------------------------------------------------------------------
 
-def init_cache(model: LlamaModel, batch_size: int, max_len: int):
+def init_cache(model: LlamaModel, batch_size: int, max_len: int,
+               kv_sharding=None, scalar_sharding=None):
     """Zeroed KV cache pytree sized (batch, kv_heads, max_len, head_dim) per
     layer. Built via ``jax.eval_shape`` over ``init`` — no parameter compute,
-    just the variable-tree structure."""
+    just the variable-tree structure.
+
+    ``kv_sharding`` (a ``jax.sharding.Sharding``) places the 4-D K/V
+    leaves at creation — the tensor-parallel serving backend passes the
+    head-sharded ``Mesh(('tp',))`` spec so a big cache is born
+    distributed (each device allocates its ``1/tp`` shard) instead of
+    materialized on one device and re-shuffled. ``scalar_sharding``
+    places the scalar ``idx`` leaves (replicated under a mesh)."""
     shapes = jax.eval_shape(
         lambda: model.init(jax.random.PRNGKey(0),
                            jnp.zeros((batch_size, max_len), jnp.int32),
                            decode=True))
-    return jax.tree_util.tree_map(
-        lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"])
+
+    def make(s):
+        sh = kv_sharding if len(s.shape) == 4 else scalar_sharding
+        if sh is not None:
+            return jax.make_array_from_callback(
+                s.shape, sh, lambda idx: np.zeros(
+                    tuple(len(range(*i.indices(d)))
+                          for i, d in zip(idx, s.shape)), s.dtype))
+        return jnp.zeros(s.shape, s.dtype)
+
+    return jax.tree_util.tree_map(make, shapes["cache"])
 
 
 def _sample(logits, key, temperature: float, top_k: int = 0,
@@ -919,14 +936,20 @@ def slot_verify_step(model, params, cache, tokens, slot_cur, pad_lens):
 # primitives pin).
 
 
-def init_paged_pool(model: LlamaModel, pool_blocks: int, block_size: int):
+def init_paged_pool(model: LlamaModel, pool_blocks: int, block_size: int,
+                    kv_sharding=None, scalar_sharding=None):
     """Zeroed shared K/V pool: per layer ``[pool_blocks, kv_heads,
     block_size, head_dim]`` — structurally a ``init_cache`` with
     batch=pool_blocks and max_len=block_size, which is exactly the
     block-major paged layout. Block 0 is conventionally the trash block
     (``serving.paging.BlockAllocator``): idle slots' tables point at
-    it, so masked garbage writes land where no request reads."""
-    return init_cache(model, int(pool_blocks), int(block_size))
+    it, so masked garbage writes land where no request reads.
+    ``kv_sharding`` places every block's ``Hkv`` axis over a tp mesh —
+    block ids stay logical/device-count-agnostic, each device holds
+    ``1/tp`` of every block (see :func:`init_cache`)."""
+    return init_cache(model, int(pool_blocks), int(block_size),
+                      kv_sharding=kv_sharding,
+                      scalar_sharding=scalar_sharding)
 
 
 def _pool_block_size(pool) -> int:

@@ -8,12 +8,14 @@ from .pipeline import (gpipe, microbatch, stack_stage_params,
                        stage_sharding)
 from .ring_attention import (dense_attention, ring_attention,
                              ulysses_attention)
-from .sharding import (describe, fsdp_rules, lora_rules, make_rules,
+from .sharding import (SpecLayout, describe, divisible_rules, fsdp_rules,
+                       lora_rules, make_rules, serving_tp_layout,
                        shard_params, sharding_pytree, transformer_tp_rules)
 
 __all__ = [
     "make_rules", "shard_params", "sharding_pytree", "describe",
     "transformer_tp_rules", "lora_rules", "fsdp_rules",
+    "SpecLayout", "serving_tp_layout", "divisible_rules",
     "ring_attention", "ulysses_attention", "dense_attention",
     "gpipe", "microbatch", "stack_stage_params", "stage_sharding",
     "SwitchMoE", "moe_rules", "moe_aux_loss",

@@ -10,6 +10,7 @@ the specs into ICI collectives; no manual comms anywhere.
 
 from __future__ import annotations
 
+import dataclasses
 import re
 from typing import Any, Callable, Sequence
 
@@ -179,6 +180,84 @@ def fsdp_rules(base_rules: Callable | None = None,
     # and saves nothing)
     rules.match_str = getattr(base_rules, "match_str", None)
     return rules
+
+
+def divisible_rules(base_rules: Callable, mesh: Mesh) -> Callable:
+    """Wrap a rule fn so any spec axis that does not divide its leaf dim
+    evenly is dropped (that dim replicated) instead of failing at
+    ``device_put``. GSPMD would pad-and-reshard an uneven split on every
+    use — worse than replicating the one odd leaf (typically a
+    non-power-of-two vocab table). The same policy ``fsdp_rules`` applies
+    to the data axis, generalized to every axis of the spec."""
+    def rules(path, leaf) -> P:
+        spec = base_rules(path, leaf)
+        shape = getattr(leaf, "shape", None)
+        if shape is None or not any(spec):
+            return spec
+        out = []
+        for i, ax in enumerate(spec):
+            if ax is not None and (i >= len(shape)
+                                   or shape[i] % int(mesh.shape[ax])):
+                ax = None  # uneven split: replicate this dim
+            out.append(ax)
+        return P(*out)
+
+    rules.match_str = getattr(base_rules, "match_str", None)
+    return rules
+
+
+# ---------------------------------------------------------------------------
+# Named layouts (SpecLayout) — serving tensor parallelism (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class SpecLayout:
+    """A self-contained sharding layout: the param rules plus the specs
+    for every non-param tensor a consumer must place. Param-pattern
+    rules alone are not a layout — the serving backend also owns a KV
+    cache (or paged pool) and a handful of replicated host vectors, and
+    the three specs must agree on the mesh axis or GSPMD silently
+    reshards per call. Bundling them is what lets the slot backends
+    apply tensor parallelism without any per-tensor sharding code."""
+
+    rules: Callable          # param-path pattern rules (first match wins)
+    kv_cache: P              # [B|pool, Hkv, S|bs, hd] K/V leaves
+    replicated: P            # tokens / fill indices / tables / rng
+    axis: str = "tp"         # the mesh axis the layout shards over
+    degree: int = 1          # axis extent (1 = no sharding anywhere)
+
+
+def serving_tp_layout(tp: int, cfg: Any = None, *,
+                      axis: str = "tp") -> SpecLayout:
+    """The serving-engine tensor-parallel layout (Megatron-style, ISSUE
+    14): attention q/k/v head-sharded (the KV cache's ``Hkv`` axis
+    shards with them, so each device holds ``1/tp`` of every cache row
+    or pool block), o_proj row-sharded, MLP column-then-row — ONE
+    all-reduce per block, inserted by GSPMD from the layout; logits and
+    the sampled argmax come out replicated, so the jax-free scheduler's
+    greedy contract is untouched.
+
+    ``cfg`` (optional, any object with the ``LlamaConfig`` head fields)
+    is validated up front: head-sharding is only exact when the KV-head
+    and Q-head counts divide by ``tp`` — an uneven KV split would give
+    devices different slices of the cache's sharded axis, which the
+    block-table arithmetic (and the 1/tp per-device byte contract)
+    cannot express. Weight dims are handled more leniently: the rules
+    are wrapped per-mesh by :func:`divisible_rules` at ``shard_params``
+    time (an odd vocab table replicates instead of erroring)."""
+    if tp < 1:
+        raise ValueError(f"tp must be >= 1, got {tp}")
+    if cfg is not None and tp > 1:
+        for field in ("num_kv_heads", "num_heads"):
+            v = getattr(cfg, field, None)
+            if v is not None and v % tp:
+                raise ValueError(
+                    f"{field}={v} is not divisible by tp={tp}: "
+                    f"head-sharded serving needs an even head split "
+                    f"(pick tp from the divisors of {field})")
+    return SpecLayout(rules=transformer_tp_rules(model_axis=axis),
+                      kv_cache=P(None, axis, None, None),
+                      replicated=P(), axis=axis, degree=int(tp))
 
 
 def lora_rules(base_rules: Callable, model_axis: str = "model") -> Callable:

@@ -100,6 +100,24 @@ class InjectedFatal(InjectedFault):
     """Fatal: shaped like an INVALID_ARGUMENT program error."""
 
 
+# The one announcement string for DELIBERATE fault injection in
+# measurement/dryrun legs (MULTICHIP records, __graft_entry__): the
+# record tooling separates injected_chaos from real failures by this
+# convention, so the wording must not drift between the legs that
+# print it (announce_injection is the single definition).
+CHAOS_INJECTED_MARKER = "[chaos-injected]"
+
+
+def announce_injection(what: str = "a deliberate retryable failure"):
+    """Print the standard fault-injection announcement to stderr —
+    call immediately before raising an injected failure in a dryrun /
+    record leg, so the captured tail can never read the restart as a
+    real regression (the MULTICHIP_r05 lesson)."""
+    import sys
+    print(f"{CHAOS_INJECTED_MARKER} raising {what} (fault-injection "
+          f"leg — the restart below is EXPECTED)", file=sys.stderr)
+
+
 def _this_rank() -> int:
     return int(os.environ.get("SPARKDL_PROCESS_ID", "0"))
 

@@ -81,9 +81,15 @@ from .failures import PoisonDataError
 
 MAX_SKIP_ENV = "SPARKDL_MAX_SKIPPED_BATCHES"
 _DEFAULT_MAX_SKIPPED = 16
+# Tensor-parallel serving placement (ISSUE 14): when a gang's env names
+# a tp degree, every rank gets a DISJOINT tp-sized device group (see
+# tp_placement_env) so a supervised gang can host N independent tp
+# engines on one host without fighting over chips.
+SERVE_TP_ENV = "SPARKDL_SERVE_TP"
+TP_OFFSET_ENV = "SPARKDL_TP_DEVICE_OFFSET"
 
 __all__ = ["launch", "supervise", "free_port", "GangFailure",
-           "SuperviseResult"]
+           "SuperviseResult", "tp_placement_env"]
 
 log = logging.getLogger("sparkdl_tpu.runner")
 
@@ -238,6 +244,86 @@ class _Drain:
         return self._text(self._err)
 
 
+def host_device_flags(flags: str, n: int) -> str:
+    """Merge ``--xla_force_host_platform_device_count=n`` into an
+    XLA_FLAGS string, respecting a caller-pinned value — the ONE
+    flag-merge policy shared by per-rank tp placement, the tp bench
+    subprocess and the MULTICHIP record script (three hand-rolled
+    copies would drift)."""
+    flags = flags or ""
+    if "xla_force_host_platform_device_count" in flags:
+        return flags
+    return (flags + f" --xla_force_host_platform_device_count={n}").strip()
+
+
+def tp_placement_env(rank: int, tp: int, merged_env: dict) -> dict:
+    """Topology-aware per-rank device placement for a gang hosting
+    tensor-parallel serving engines (ISSUE 14): each rank must end up
+    with its OWN disjoint ``tp``-device group, or co-hosted engines
+    would build meshes over the same chips.
+
+    Three placement regimes, most specific caller setting always wins:
+
+    - **CPU / virtual devices** (``JAX_PLATFORMS=cpu``): every rank is
+      its own process with its own virtual device pool — force
+      ``--xla_force_host_platform_device_count=tp`` (when the caller
+      has not pinned the flag) and mesh from offset 0.
+    - **Real accelerators, no explicit visibility**: pin per-rank chip
+      visibility (``TPU_VISIBLE_CHIPS`` = the rank's contiguous chip
+      group) so each process initializes only its own chips; mesh from
+      offset 0 of the visible set.
+    - **Caller-pinned visibility** (``TPU_VISIBLE_CHIPS`` already in
+      the env): ranks share the operator's visible set — place by
+      in-process offset instead (``SPARKDL_TP_DEVICE_OFFSET`` =
+      ``rank * tp``, consumed by ``serving.backend.tp_mesh``).
+
+    Returns only the ADDITIONS for this rank; an explicitly-set
+    ``SPARKDL_TP_DEVICE_OFFSET`` is never overridden."""
+    if tp <= 1:
+        return {}
+    add: dict = {}
+    # First entry of the (possibly comma-separated fallback) platform
+    # list decides the regime: JAX_PLATFORMS="tpu,cpu" initializes the
+    # TPU backend, so it must take the chip-visibility branch — a
+    # substring test would route it to virtual devices and leave every
+    # rank meshing over the same first chips.
+    platform = (merged_env.get("JAX_PLATFORMS") or "").lower() \
+        .split(",")[0].strip()
+    explicit_off = TP_OFFSET_ENV in merged_env
+    if platform == "cpu":
+        flags = merged_env.get("XLA_FLAGS", "")
+        merged = host_device_flags(flags, tp)
+        if merged != flags:
+            add["XLA_FLAGS"] = merged
+        if not explicit_off:
+            add[TP_OFFSET_ENV] = "0"
+    elif "TPU_VISIBLE_CHIPS" not in merged_env:
+        add["TPU_VISIBLE_CHIPS"] = ",".join(
+            str(rank * tp + i) for i in range(tp))
+        if not explicit_off:
+            add[TP_OFFSET_ENV] = "0"
+    elif not explicit_off:
+        add[TP_OFFSET_ENV] = str(rank * tp)
+    return add
+
+
+def _tp_degree(env: dict) -> int:
+    raw = env.get(SERVE_TP_ENV, "") or 0
+    try:
+        tp = int(raw)
+    except ValueError:
+        # The caller explicitly asked for tp placement with a value we
+        # cannot honor — failing the spawn loudly beats silently
+        # launching a gang whose ranks then fight over chips.
+        raise ValueError(
+            f"{SERVE_TP_ENV}={raw!r} in the gang env is not an "
+            f"integer") from None
+    if tp < 0:
+        raise ValueError(
+            f"{SERVE_TP_ENV}={raw!r} in the gang env is negative")
+    return tp
+
+
 def _spawn_gang(script: str, np: int, args, env, coordinator: str | None,
                 capture: bool, heartbeat_dir: str | None = None,
                 event_dir: str | None = None):
@@ -266,6 +352,16 @@ def _spawn_gang(script: str, np: int, args, env, coordinator: str | None,
         cache_dir = penv.get("SPARKDL_COMPILE_CACHE")
         if cache_dir and not penv.get("JAX_COMPILATION_CACHE_DIR"):
             penv["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+        # Tensor-parallel serving gang (ISSUE 14): give this rank its
+        # disjoint tp-device group (virtual-device flag on CPU, chip
+        # visibility / in-process offset on real accelerators). Gated
+        # on the CALLER'S env= dict, not the merged process env — an
+        # operator's shell-exported SPARKDL_SERVE_TP must never
+        # silently rewrite device topology for an unrelated (e.g.
+        # training) gang; a gang that wants tp placement asks for it.
+        tp = _tp_degree(env or {})
+        if tp > 1:
+            penv.update(tp_placement_env(rank, tp, penv))
         p = subprocess.Popen(
             [sys.executable, script] + list(args or []),
             env=penv,

@@ -66,6 +66,7 @@ import numpy as np
 
 import functools
 import logging
+import os
 
 from ..core.runtime import GLOBAL_COMPILE_CACHE
 from ..models import llama as L
@@ -142,6 +143,10 @@ class LlamaSlotBackend:
     many refills happen.
     """
 
+    #: tensor-parallel degree — 1 for the single-device backends; the
+    #: TensorParallel* subclasses set it to the tp mesh extent.
+    tp_degree = 1
+
     def __init__(self, model, variables, num_slots: int, max_len: int, *,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, seed: int = 0,
@@ -159,7 +164,7 @@ class LlamaSlotBackend:
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self.top_p = float(top_p)
-        self.cache = L.init_cache(model, self.num_slots, self.max_len)
+        self.cache = self._make_cache(model)
         self._tokens = np.zeros(self.num_slots, np.int32)
         # Idle slots park at fill index 0 — their write frontier: the
         # step's (masked, discarded) write lands exactly where the next
@@ -173,6 +178,33 @@ class LlamaSlotBackend:
             else max(0, int(prefix_cache_bytes))
         self.prefix_cache = PrefixCache(budget) if budget > 0 else None
         self._warned_commit = False
+
+    def _make_cache(self, model):
+        """Cache-allocation hook: the TP subclasses pass the
+        head-sharded mesh placement so a big cache is born distributed
+        instead of allocated on one device and reshuffled."""
+        return L.init_cache(model, self.num_slots, self.max_len)
+
+    def kv_pool_device_bytes(self) -> int:
+        """PER-DEVICE K/V bytes of the slot cache / paged pool: the max
+        over devices of summed K/V shard bytes — the whole cache on a
+        single-device backend, ``total / tp`` under the head-sharded
+        tensor-parallel layout. The engine exports it as the
+        ``serving_kv_pool_device_bytes`` gauge; the tp bench leg pins
+        the ``1/tp`` shrink on it."""
+        per: dict = {}
+        for leaf in jax.tree_util.tree_leaves(self.cache):
+            if getattr(leaf, "ndim", 0) != 4:
+                continue
+            shards = getattr(leaf, "addressable_shards", None)
+            if shards:
+                for s in shards:
+                    d = s.data
+                    per[s.device.id] = per.get(s.device.id, 0) + \
+                        d.size * d.dtype.itemsize
+            else:
+                per[-1] = per.get(-1, 0) + leaf.size * leaf.dtype.itemsize
+        return max(per.values(), default=0)
 
     # -- engine protocol --------------------------------------------------
     def prefill(self, slot: int, prompt, bucket: int) -> int:
@@ -482,7 +514,13 @@ class PagedLlamaSlotBackend(LlamaSlotBackend):
         self.top_k = int(top_k)
         self.top_p = float(top_p)
         if pool_blocks is None and kv_pool_mb is not None:
-            per = pool_bytes_per_block(model, self.block_size)
+            # PER-DEVICE budget → block count: on the single-device
+            # backend a block's device cost is its full K/V bytes; the
+            # TP subclass overrides the hook with bytes/tp (each device
+            # holds 1/tp of every block), so the same per-device
+            # SPARKDL_SERVE_KV_POOL_MB buys tp× the blocks — more KV
+            # at the same per-chip memory, the scale-out point.
+            per = self._pool_block_device_bytes(model)
             pool_blocks = max(2, int(kv_pool_mb * 2 ** 20) // per)
         budget = prefix_cache_budget_bytes() if prefix_cache_bytes is None \
             else max(0, int(prefix_cache_bytes))
@@ -496,8 +534,7 @@ class PagedLlamaSlotBackend(LlamaSlotBackend):
             radix=budget > 0,
             on_table=self._set_table, copy_block=self._copy_block)
         self.pool_blocks = self.mgr.pool_blocks
-        self.cache = L.init_paged_pool(model, self.pool_blocks,
-                                       self.block_size)
+        self.cache = self._make_pool(model)
         self.allocator = self.mgr.allocator
         self.radix = self.mgr.radix
         self._tokens = np.zeros(self.num_slots, np.int32)
@@ -508,6 +545,14 @@ class PagedLlamaSlotBackend(LlamaSlotBackend):
         self._prefill_i = 0
         self.prefix_cache = None  # the byte-payload LRU does not apply
         self._warned_commit = False
+
+    def _pool_block_device_bytes(self, model) -> int:
+        """Per-DEVICE bytes one pool block costs (see ``__init__``)."""
+        return pool_bytes_per_block(model, self.block_size)
+
+    def _make_pool(self, model):
+        """Pool-allocation hook (see ``LlamaSlotBackend._make_cache``)."""
+        return L.init_paged_pool(model, self.pool_blocks, self.block_size)
 
     # -- allocation plumbing (policy lives in PagedBlockManager) ----------
     def _set_table(self, slot: int, idx: int, block: int) -> None:
@@ -684,3 +729,132 @@ class PagedLlamaSlotBackend(LlamaSlotBackend):
         self._cur[slot] = 0
         self._pads[slot] = 0
         self._tokens[slot] = 0
+
+
+# ---------------------------------------------------------------------------
+# Tensor-parallel slot backends (ISSUE 14): one engine spanning a mesh
+# ---------------------------------------------------------------------------
+
+# ONE definition of the placement knob (the launcher owns placement);
+# scrub_serving_env and tp_mesh both ride it, so a rename cannot leave
+# one surface reading (or scrubbing) a stale name.
+from ..runner.launcher import TP_OFFSET_ENV  # noqa: E402
+
+
+def tp_mesh(tp: int, devices=None):
+    """``Mesh(('tp',))`` over ``tp`` devices starting at
+    ``SPARKDL_TP_DEVICE_OFFSET`` (default 0) of the visible device list
+    — the launcher's topology-aware placement sets the offset per rank
+    so co-hosted engines claim disjoint device groups."""
+    import jax as _jax
+    devs = list(devices) if devices is not None else _jax.devices()
+    raw = os.environ.get(TP_OFFSET_ENV, "0") or 0
+    try:
+        off = int(raw)
+    except ValueError:
+        # name the knob: a rank debugging a failed gang must see WHICH
+        # env var was bad (the SPARKDL_SERVE_TP error convention)
+        raise ValueError(
+            f"{TP_OFFSET_ENV}={raw!r} is not an integer") from None
+    if tp < 1:
+        raise ValueError(f"tp must be >= 1, got {tp}")
+    if off < 0 or off + tp > len(devs):
+        raise ValueError(
+            f"tp={tp} needs devices [{off}, {off + tp}) but only "
+            f"{len(devs)} are visible (offset from {TP_OFFSET_ENV}; on "
+            f"CPU force a bigger mesh with XLA_FLAGS="
+            f"--xla_force_host_platform_device_count=N)")
+    from jax.sharding import Mesh
+    return Mesh(np.array(devs[off:off + tp]), ("tp",))
+
+
+def _tp_setup(self, model, tp: int, mesh):
+    """The whole tensor-parallel delta over the single-device backends
+    (ISSUE 14 tentpole), half 1 — runs BEFORE ``super().__init__`` so
+    the cache/pool allocation hooks see the mesh: validate the
+    :func:`parallel.sharding.serving_tp_layout` SpecLayout against the
+    model's head counts, build/adopt the ``Mesh(('tp',))``, derive the
+    placement shardings, and pin dense in-model attention (the Pallas
+    flash kernels do not partition under GSPMD — a sharded
+    paged-attention kernel is the named follow-on, not a silent
+    correctness risk). The FOUR jitted donated-cache slot primitives
+    (and their paged variants) then run UNCHANGED: GSPMD propagates
+    the input shardings through every scatter/gather, keeps the cache
+    head-sharded across donation, inserts the Megatron
+    one-allreduce-per-block collectives, and hands back replicated
+    logits/argmax — the jax-free scheduler (and ``PagedBlockManager``'s
+    logical block ids) see exactly the single-device contract. No pjit
+    wrapper, no re-implemented method; tp<=1 callers never construct
+    these classes at all (``GenerationEngine.from_model`` routes tp<=1
+    to the exact base classes — pinned by a signature-equality test)."""
+    from jax.sharding import NamedSharding
+
+    from ..parallel.sharding import serving_tp_layout
+    layout = serving_tp_layout(tp, getattr(model, "cfg", None))
+    self.tp_degree = int(tp)
+    self.layout = layout
+    self.mesh = mesh if mesh is not None else tp_mesh(tp)
+    self._kv_sharding = NamedSharding(self.mesh, layout.kv_cache)
+    self._replicated = NamedSharding(self.mesh, layout.replicated)
+    # Pallas flash kernels do not partition under GSPMD: pin the dense
+    # in-model attention for every sharded program (the "auto" default
+    # would pick flash on TPU and fail to partition).
+    return model.clone(attn_fn=None)
+
+
+def _tp_finish(self):
+    """The tensor-parallel delta, half 2 — runs AFTER
+    ``super().__init__``: sharded weights loaded ONCE (device placement
+    per the SpecLayout pattern rules, odd dims replicated via
+    ``divisible_rules``), rng replicated."""
+    from ..parallel.sharding import divisible_rules, shard_params
+    self.params = shard_params(
+        self.params, self.mesh,
+        divisible_rules(self.layout.rules, self.mesh))
+    self._rng = jax.device_put(self._rng, self._replicated)
+
+
+class TensorParallelLlamaSlotBackend(LlamaSlotBackend):
+    """Head-sharded :class:`LlamaSlotBackend` over a ``Mesh(('tp',))``
+    (see the tensor-parallel section of the module doc): the slot cache
+    leaves ``[slots, Hkv, max_len, hd]`` shard on ``Hkv``, q/k/v
+    projections by head, MLP column-then-row, logits replicated — all
+    four slot primitives run unchanged and per-device cache bytes are
+    ``1/tp`` (:meth:`kv_pool_device_bytes`)."""
+
+    def __init__(self, model, variables, num_slots: int, max_len: int, *,
+                 tp: int, mesh=None, **kw):
+        model = _tp_setup(self, model, tp, mesh)
+        super().__init__(model, variables, num_slots, max_len, **kw)
+        _tp_finish(self)
+
+    def _make_cache(self, model):
+        return L.init_cache(model, self.num_slots, self.max_len,
+                            kv_sharding=self._kv_sharding,
+                            scalar_sharding=self._replicated)
+
+
+class TensorParallelPagedLlamaSlotBackend(PagedLlamaSlotBackend):
+    """Head-sharded :class:`PagedLlamaSlotBackend`: every pool block
+    ``[Hkv, block_size, hd]`` shards its ``Hkv`` axis over the tp mesh,
+    so block ids stay LOGICAL (device-count-agnostic — the jax-free
+    ``PagedBlockManager``, radix trie, CoW and preemption policy work
+    verbatim) while each device holds ``1/tp`` of every block.
+    ``kv_pool_mb`` is a PER-DEVICE budget: the block-count conversion
+    divides a block's bytes by ``tp``, so a tp=4 engine holds 4× the KV
+    of the single-device engine at the same per-chip memory."""
+
+    def __init__(self, model, variables, num_slots: int, max_len: int, *,
+                 tp: int, mesh=None, **kw):
+        model = _tp_setup(self, model, tp, mesh)
+        super().__init__(model, variables, num_slots, max_len, **kw)
+        _tp_finish(self)
+
+    def _pool_block_device_bytes(self, model) -> int:
+        return max(1, pool_bytes_per_block(model, self.block_size)
+                   // self.tp_degree)
+
+    def _make_pool(self, model):
+        return L.init_paged_pool(model, self.pool_blocks, self.block_size,
+                                 kv_sharding=self._kv_sharding,
+                                 scalar_sharding=self._replicated)

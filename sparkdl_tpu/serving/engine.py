@@ -142,6 +142,14 @@ KV_POOL_MB_ENV = "SPARKDL_SERVE_KV_POOL_MB"
 # verify -> greedy commit (always >= 1 token per slot per iteration).
 # SPEC_DRAFT names the draft provider (serving.draft.make_provider).
 SPEC_K_ENV = "SPARKDL_SERVE_SPEC_K"
+# ISSUE 14 — tensor-parallel serving. TP is the mesh extent one engine
+# spans: 1 (the default) constructs the EXACT single-device backends
+# (no mesh, no wrapper, zero overhead); > 1 selects the head-sharded
+# TensorParallel* backends whose weights/KV shard over Mesh(('tp',))
+# while this scheduler stays byte-for-byte unchanged. The launcher's
+# topology-aware placement gives each gang rank a disjoint device
+# group (SPARKDL_TP_DEVICE_OFFSET / per-rank visibility).
+TP_ENV = "SPARKDL_SERVE_TP"
 
 _DEFAULT_SLOTS = 8
 _DEFAULT_MAX_LEN = 2048
@@ -177,6 +185,25 @@ def _env_num(name: str, default, cast=int):
         return cast(os.environ[name])
     except (KeyError, ValueError):
         return default
+
+
+def scrub_serving_env(env: dict | None = None) -> dict:
+    """Remove every serving knob (``SPARKDL_SERVE_*`` plus
+    ``SPARKDL_TP_DEVICE_OFFSET``) from ``env`` — default the process
+    environment — returning the removed entries so a caller can
+    restore them. The ONE implementation of evidence hygiene for the
+    tp bench leg, the MULTICHIP record script and the dryrun leg: an
+    ambient ``SPARKDL_SERVE_KV_POOL_MB`` (a per-DEVICE budget) would
+    size every tp degree's pool to ~equal device bytes and silently
+    invert their 1/tp observable, and STALL_FREE/SPEC/PREFIX overrides
+    would change which composition actually ran."""
+    from ..runner.launcher import TP_OFFSET_ENV  # one shared definition
+    target = os.environ if env is None else env
+    removed = {}
+    for k in list(target):
+        if k.startswith("SPARKDL_SERVE_") or k == TP_OFFSET_ENV:
+            removed[k] = target.pop(k)
+    return removed
 
 
 class ServingError(RuntimeError):
@@ -532,6 +559,16 @@ class GenerationEngine:
         # pool blocks, decode growth allocates lazily, exhaustion
         # backpressures (the request waits) instead of crashing.
         self.paged = bool(getattr(backend, "paged", False))
+        # Tensor-parallel degree + per-device KV-pool bytes (ISSUE 14):
+        # both are engine-lifetime constants (the cache's shapes and
+        # placement never change), so read them once here and export
+        # them as gauges each iteration when the plane is armed.
+        self.tp_degree = int(getattr(backend, "tp_degree", 1) or 1)
+        kb = getattr(backend, "kv_pool_device_bytes", None)
+        try:
+            self.kv_pool_device_bytes = int(kb()) if callable(kb) else None
+        except Exception:  # noqa: BLE001 — accounting, never fatal
+            self.kv_pool_device_bytes = None
         # Stall-free scheduling (SPARKDL_SERVE_STALL_FREE, default on):
         # prompts are consumed in fixed-size chunks interleaved with the
         # decode step instead of blocking it for a whole O(L^2) prefill.
@@ -652,6 +689,7 @@ class GenerationEngine:
                    block_size: int | None = None,
                    pool_blocks: int | None = None,
                    kv_pool_mb: float | None = None,
+                   tp: int | None = None, mesh=None,
                    **kw) -> "GenerationEngine":
         """Build an engine over :class:`serving.backend.LlamaSlotBackend`
         (the jax import happens here, not at module import).
@@ -663,31 +701,94 @@ class GenerationEngine:
         blocks (or ``kv_pool_mb`` / ``SPARKDL_SERVE_KV_POOL_MB``
         converted; default = the un-paged footprint) addressed through
         per-slot block tables, with block-granular radix prefix sharing
-        instead of the copy-based LRU."""
+        instead of the copy-based LRU.
+
+        ``tp`` > 1 (or ``SPARKDL_SERVE_TP``) spans the engine over a
+        tensor-parallel mesh (ISSUE 14): head-sharded weights + KV
+        cache/pool over ``tp`` devices (``mesh`` to supply one;
+        otherwise ``serving.backend.tp_mesh`` builds it from the
+        visible devices at ``SPARKDL_TP_DEVICE_OFFSET``). tp <= 1 is
+        exactly the single-device path — same classes, same compiled
+        signatures. Paged + tp makes ``kv_pool_mb`` a PER-DEVICE
+        budget (each device holds 1/tp of every block)."""
         num_slots = num_slots if num_slots is not None \
             else _env_num(SLOTS_ENV, _DEFAULT_SLOTS)
         max_len = max_len if max_len is not None \
             else _env_num(MAX_LEN_ENV, _DEFAULT_MAX_LEN)
         block_size = block_size if block_size is not None \
             else _env_num(BLOCK_SIZE_ENV, 0)
+        tp_explicit = tp is not None
+        if tp is None:
+            raw = os.environ.get(TP_ENV)
+            if raw in (None, ""):
+                tp = 1
+            else:
+                tp_explicit = True  # the operator pinned a degree
+                try:
+                    tp = int(raw)
+                except ValueError:
+                    # Losing tensor parallelism silently means a model
+                    # sized for tp chips quietly not fitting (or 1/tp
+                    # the KV) — a malformed knob raises as loudly as a
+                    # wrong one (the SPARKDL_SERVE_SPEC_DRAFT rule).
+                    raise ValueError(
+                        f"{TP_ENV}={raw!r} is not an integer") from None
+        if tp is not None and int(tp) < 0:
+            # Checked BEFORE the mesh branch: a negative explicit tp
+            # alongside a mesh must not be silently overwritten by the
+            # mesh extent — a sign bug raises like every other bad tp.
+            raise ValueError(f"tp={tp} is negative (0/1 = single-device)")
+        if mesh is not None:
+            try:
+                extent = 1
+                for v in mesh.shape.values():
+                    extent *= int(v)
+            except Exception as e:
+                raise ValueError(
+                    "mesh= was given but its extent could not be read; "
+                    "pass tp= explicitly") from e
+            if not tp_explicit and (not tp or tp <= 1):
+                # An explicitly passed mesh IS the tensor-parallel
+                # request: infer the degree from its total extent
+                # instead of silently dropping the mesh and building a
+                # single-device engine with the full unsharded KV.
+                # Only a DEFAULTED tp infers — an explicit tp=1 (arg
+                # or SPARKDL_SERVE_TP=1, the pinned single-device
+                # baseline) disagreeing with a multi-device mesh
+                # raises below like every other mismatch.
+                tp = extent
+            elif int(tp) != extent:
+                # A disagreeing pair would validate heads against tp
+                # but shard over the mesh: per-device budget math and
+                # the tp observables all report the wrong degree.
+                raise ValueError(
+                    f"tp={tp} disagrees with the passed mesh's "
+                    f"{extent} device(s)")
         pbytes = None if prefix_cache_mb is None \
             else int(prefix_cache_mb * 2 ** 20)
+        tp_kw = {"tp": int(tp), "mesh": mesh} if tp and tp > 1 else {}
         if block_size and block_size > 0:
-            from .backend import PagedLlamaSlotBackend  # deferred: jax
+            from .backend import (PagedLlamaSlotBackend,
+                                  TensorParallelPagedLlamaSlotBackend)
             kv_pool_mb = kv_pool_mb if kv_pool_mb is not None \
                 else _env_num(KV_POOL_MB_ENV, None, float)
-            backend = PagedLlamaSlotBackend(
+            klass = TensorParallelPagedLlamaSlotBackend if tp_kw \
+                else PagedLlamaSlotBackend
+            backend = klass(
                 model, variables, num_slots, max_len,
                 block_size=int(block_size), pool_blocks=pool_blocks,
                 kv_pool_mb=kv_pool_mb, temperature=temperature,
                 top_k=top_k, top_p=top_p, seed=seed,
-                prefix_cache_bytes=pbytes)
+                prefix_cache_bytes=pbytes, **tp_kw)
         else:
-            from .backend import LlamaSlotBackend  # deferred: jax
-            backend = LlamaSlotBackend(
+            from .backend import (LlamaSlotBackend,
+                                  TensorParallelLlamaSlotBackend)
+            klass = TensorParallelLlamaSlotBackend if tp_kw \
+                else LlamaSlotBackend
+            backend = klass(
                 model, variables, num_slots, max_len,
                 temperature=temperature, top_k=top_k, top_p=top_p,
-                seed=seed, prefix_cache_bytes=pbytes)
+                seed=seed, prefix_cache_bytes=pbytes, **tp_kw)
         return cls(backend, eos_id=eos_id, **kw)
 
     # -- telemetry helpers ------------------------------------------------
@@ -849,6 +950,10 @@ class GenerationEngine:
         if busy > self.stats["peak_slots_busy"]:
             self.stats["peak_slots_busy"] = busy
         self._metric("gauge", "serving_slots_busy", busy)
+        self._metric("gauge", "serving_tp_degree", self.tp_degree)
+        if self.kv_pool_device_bytes is not None:
+            self._metric("gauge", "serving_kv_pool_device_bytes",
+                         self.kv_pool_device_bytes)
         if self.paged:
             self._export_pool_metrics()
         if not active:
@@ -1777,6 +1882,8 @@ class GenerationEngine:
                 "prefill_budget": self.prefill_budget,
                 "paged": self.paged,
                 "spec_k": self.spec_k,
+                "tp_degree": self.tp_degree,
+                "kv_pool_device_bytes": self.kv_pool_device_bytes,
                 **dict(self.stats),
             }
         ps = getattr(self.backend, "prefix_stats", None)

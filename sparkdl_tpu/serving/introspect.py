@@ -86,6 +86,12 @@ def engine_debug_state(eng) -> dict:
         "paged": eng.paged,
         "stall_free": eng.stall_free,
         "spec_k": eng.spec_k,
+        # ISSUE 14: how many devices this engine spans and what the KV
+        # cache/pool costs EACH of them — the operator's first question
+        # about a multi-chip engine ("is the pool really 1/tp here?")
+        "tp_degree": getattr(eng, "tp_degree", 1),
+        "kv_pool_device_bytes": getattr(eng, "kv_pool_device_bytes",
+                                        None),
         "num_slots": len(slots),
         "slots_busy": sum(r is not None for r in slots),
         "loop_running": running,

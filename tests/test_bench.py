@@ -161,6 +161,37 @@ def test_stub_spec_leg_beats_k0_engine():
     assert rec["spec_token_identical"] is True, rec
 
 
+def test_serve_headline_carries_tp_fields():
+    """ISSUE 14: the tp leg's identity / per-device-pool-bytes /
+    re-trace evidence must ride ``_serve_headline`` into BOTH the
+    healthy and backend_unavailable records (never-host-blind rule) —
+    jax-free mapping pin on a synthetic serve record."""
+    import bench
+
+    serve = {
+        "engine": {"8": {"tokens_s": 100.0}},
+        "tp": {
+            "tp_identical": True,
+            "kv_pool_device_bytes": {"1": 1000, "2": 500, "4": 250},
+            "kv_pool_device_frac": {"1": 1.0, "2": 0.5, "4": 0.25},
+            "degrees": {
+                "1": {"decode_retrace_after_warmup": 0,
+                      "verify_retrace_after_warmup": 0},
+                "2": {"decode_retrace_after_warmup": 0,
+                      "verify_retrace_after_warmup": 0},
+            },
+        },
+    }
+    out = bench._serve_headline(serve)
+    assert out["serve_tp_identical"] is True
+    assert out["serve_tp_kv_pool_device_bytes"]["4"] == 250
+    assert out["serve_tp_kv_pool_device_frac"]["2"] == 0.5
+    assert out["serve_tp_retraces_after_warmup"] == 0
+    # a tp-less record (BENCH_SKIP_TP / subprocess failure) adds none
+    assert "serve_tp_identical" not in bench._serve_headline(
+        {"engine": {}})
+
+
 def test_multi_chunk_budget_admits_multiple_slots_per_iteration():
     """The ISSUE 11 budget pin: where the one-chunk PR 9 budget fills 1
     slot per iteration, SPARKDL_SERVE_PREFILL_BUDGET = 2 chunks fills
@@ -212,6 +243,10 @@ def test_all_metric_legs_run_end_to_end_tiny_cpu():
                 # criterion, not a tiny-CPU one
                 "BENCH_SERVE_REQUESTS": "32", "BENCH_SERVE_SLOTS": "4",
                 "BENCH_SERVE_CONCURRENCY": "1,8",
+                # tp leg (ISSUE 14) at smoke scale: tp in {1,2} keeps
+                # the 8-virtual-device subprocess inside the budget
+                # while still proving identity + the 1/2 pool shrink
+                "BENCH_TP_REQUESTS": "12", "BENCH_TP_DEGREES": "1,2",
                 # the train leg compiles TWO signatures per swept batch
                 # size since the uint8-streamed variant landed — the old
                 # 480s/900s budgets left it no headroom on a loaded host
@@ -246,6 +281,14 @@ def test_all_metric_legs_run_end_to_end_tiny_cpu():
     assert spq["verify_retrace_after_warmup"] == 0, spq
     assert extra["serve_spec_speedup"] == spq["spec_speedup"]
     assert extra["serve_spec_accept_rate"] == spq["spec_accept_rate"]
+    # tensor-parallel leg (ISSUE 14): greedy identity across degrees,
+    # per-device pool bytes halved at tp=2, zero re-traces — mirrored
+    # into the headline next to serve_tokens_s
+    tpq = sv["tp"]
+    assert tpq["tp_identical"] is True, tpq
+    assert extra["serve_tp_identical"] is True
+    assert extra["serve_tp_kv_pool_device_frac"]["2"] == 0.5, tpq
+    assert extra["serve_tp_retraces_after_warmup"] == 0, tpq
     # backend-free ingest leg (ISSUE 7): a real host-side number with
     # before/after deltas — the record that survives TPU outages
     hi = extra["host_ingest"]

@@ -470,6 +470,51 @@ class TestPagedStubEngine:
 
 
 class TestPagedEngineOnCpu:
+    def test_resume_pad_past_table_fast_twin(self):
+        """Lean twin of the slow static-anchored test below (the tier-1
+        budget rule): same contract — a resume whose chunk plan pads
+        past the block table (served 18 with chunk 16 → aligned 32 >
+        max_len 24) must route its pad writes to the trash block, never
+        clamp them back over committed rows. Reference = the SAME
+        engine config run without the preemption (whose generate()-
+        identity the static-anchored tests pin), so the twin skips the
+        two extra generate() programs; a 1-layer model (the clobber
+        contract is per-layer-identical) halves the compile cost. The
+        slow test keeps the static anchor on the full tiny model."""
+        import dataclasses
+
+        import jax
+
+        from sparkdl_tpu.models import llama as L
+
+        cfg = dataclasses.replace(L.LlamaConfig.tiny(), num_layers=1)
+        model = L.LlamaModel(cfg)
+        variables = model.init(jax.random.PRNGKey(0),
+                               np.zeros((1, 4), np.int32))
+        rng = np.random.RandomState(13)
+        prompt = rng.randint(0, cfg.vocab_size, 16).tolist()
+
+        def make_engine():
+            return GenerationEngine.from_model(
+                model, variables, num_slots=1, max_len=24, block_size=8,
+                prefill_chunk=16, prefix_cache_mb=0)
+
+        ref_eng = make_engine()  # no preemption: the clean stream
+        ref_h = ref_eng.submit(prompt, max_new_tokens=8)
+        ref_eng.run_until_idle()
+        ref = ref_h.result(1)
+
+        eng = make_engine()
+        r = eng.submit(prompt, max_new_tokens=8)
+        eng.step()  # chunk 1
+        eng.step()  # finish + first tokens
+        assert r.state == "running" and len(r.tokens) >= 2
+        eng._preempt_newest([(r.slot, r)])  # served 18 -> aligned 32 > 24
+        eng.run_until_idle()
+        assert r.result(1) == ref
+        assert eng.snapshot()["preemptions"] == 1
+
+    @pytest.mark.slow
     def test_resume_pad_past_table_never_clobbers_committed_rows(self):
         """Review finding: a resume whose chunk plan pads past the
         block table used to CLAMP the out-of-range scatter onto the
@@ -477,7 +522,8 @@ class TestPagedEngineOnCpu:
         (chunk 16, max_len 24, served 18 -> pad positions 24..31
         landed on rows 16..23). Pad writes must route to the trash
         block: the resumed request's greedy output stays bit-identical
-        to static generate()."""
+        to static generate(). (Slow: the fast twin above pins the same
+        contract engine-vs-engine; this keeps the static anchor.)"""
         import jax
 
         from sparkdl_tpu.models import llama as L
