@@ -1168,6 +1168,29 @@ def _serve_headline(serve: dict) -> dict:
                      ("preemptions", "serve_preemptions")):
         if churn.get(src) is not None:
             out[dst] = churn[src]
+    # ISSUE 15: paged flash-decode kernel headline. The churn sub-leg
+    # (stub, rides BOTH records) carries the scheduler-invariance
+    # tokens/s ratio + the deterministic attention-bytes model; the
+    # healthy llama record additionally carries the real-kernel leg's
+    # token identity and CPU (interpret-mode) tokens/s ratio — the
+    # on-chip speedup claim is the next TPU probe's.
+    pk = churn.get("paged_kernel") or {}
+    # serve_paged_kernel_speedup is the MODELED HBM number (>= 1.0 by
+    # construction; decode is bandwidth-bound so bytes ratio ~ modeled
+    # speedup) — the stub's measured on/off pair is an A/A
+    # scheduler-invariance check and is deliberately NOT forwarded as
+    # a speedup (see the leg's honest_label).
+    for src, dst in (("modeled_hbm_speedup",
+                      "serve_paged_kernel_speedup"),
+                     ("attn_bytes_ratio",
+                      "serve_paged_kernel_attn_bytes_ratio")):
+        if pk.get(src) is not None:
+            out[dst] = pk[src]
+    lpk = serve.get("paged_kernel") or {}
+    if lpk.get("token_identical") is not None:
+        out["serve_paged_kernel_token_identical"] = lpk["token_identical"]
+    if lpk.get("cpu_speedup") is not None:
+        out["serve_paged_kernel_cpu_speedup"] = lpk["cpu_speedup"]
     # ISSUE 12: speculative-decoding headline — single-stream tokens/s
     # over the k=0 engine on the high-acceptance mix, and the top-k
     # leg's draft acceptance rate (rides healthy AND outage records).

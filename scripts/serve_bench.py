@@ -543,6 +543,31 @@ _CHURN_OUT = (4, 6, 8)          # SHORT outputs: slot churn is the load
 _CHURN_BLOCK = 16
 
 
+def attn_positions_model(workload, block_size: int, max_len: int):
+    """Deterministic per-decode-step attention-READ model for a paged
+    engine (ISSUE 15): the gather-view path reads every slot's whole
+    table (``max_blocks × block_size`` positions per slot per step)
+    while the paged flash-decode kernel reads only the slot's LIVE
+    blocks (fill rounded up to a block). Returns
+    ``(gather_positions, kernel_positions)`` summed over every decode
+    step of the workload — the HBM-traffic claim the kernel makes,
+    computable host-side (no engine instrumentation, so it rides
+    ``backend_unavailable`` records too)."""
+    mb = -(-max_len // block_size)
+    gather = sum(n * mb * block_size for _, n in workload)
+    kernel = sum(
+        sum(-(-(len(p) + i + 1) // block_size) * block_size
+            for i in range(n))
+        for p, n in workload)
+    return gather, kernel
+
+
+# K/V bytes one cache position costs in the serve-bench llama model
+# (_bench_config: 2 (K+V) x 4 kv heads x 128 head_dim x 4 B f32 x
+# 2 layers) — the reference dtype for the analytic bytes estimate.
+_BYTES_PER_POSITION = 2 * 4 * 128 * 4 * 2
+
+
 def make_churn_workload(n: int, vocab: int = 32000, seed: int = 3):
     """Short-output many-request chat mix: every prompt opens with the
     same 32-token preamble, bodies are short and distinct, outputs 4-8
@@ -605,6 +630,57 @@ def run_paged_churn_comparison(n_requests: int = 192,
                                                      paged_engine)):
         legs[name] = run_engine_leg(make, workload, concurrency=32)
     paged = legs["paged"]
+
+    # ISSUE 15 paged-kernel sub-leg (rides BOTH the healthy and the
+    # backend_unavailable record — never-host-blind): the same paged
+    # engine with the kernel knob set. The stub backend has no
+    # attention at all, so the measured on/off tokens/s delta here is
+    # a scheduler-invariance check (~1.0x — the kernel must not change
+    # the jax-free scheduling), while the HBM claim is the
+    # deterministic attention-read model: gather-view bytes vs
+    # kernel bytes per decode step over this exact workload. The
+    # on-chip measured speedup is left to the next TPU probe (the
+    # real-model CPU leg in the llama record pins token identity).
+    prev = os.environ.get("SPARKDL_SERVE_PAGED_KERNEL")
+    try:
+        os.environ["SPARKDL_SERVE_PAGED_KERNEL"] = "1"
+        kernel_on = run_engine_leg(paged_engine, workload,
+                                   concurrency=32)
+    finally:
+        if prev is None:
+            os.environ.pop("SPARKDL_SERVE_PAGED_KERNEL", None)
+        else:
+            os.environ["SPARKDL_SERVE_PAGED_KERNEL"] = prev
+    gather_pos, kernel_pos = attn_positions_model(
+        workload, _CHURN_BLOCK, max_len)
+    paged_kernel = {
+        "kernel_on_tokens_s": kernel_on.get("tokens_s"),
+        "kernel_off_tokens_s": paged.get("tokens_s"),
+        "attn_bytes_per_step": {
+            "gather_view": int(gather_pos * _BYTES_PER_POSITION
+                               // max(1, kernel_on.get("decode_steps")
+                                      or 1)),
+            "kernel": int(kernel_pos * _BYTES_PER_POSITION
+                          // max(1, kernel_on.get("decode_steps") or 1)),
+        },
+        "attn_bytes_ratio": round(gather_pos / kernel_pos, 2)
+        if kernel_pos else None,
+        "honest_label": (
+            "stub backend: no attention runs, so the on/off tokens/s "
+            "pair is an A/A scheduler-invariance check (~1.0, pure "
+            "timing noise — NOT kernel evidence); the claim-bearing "
+            "number is modeled_hbm_speedup, the deterministic "
+            "per-decode-step attention-read model at the serve-bench "
+            "llama model's K/V bytes/position (decode is "
+            "bandwidth-bound, so bytes ratio ~ modeled speedup) — "
+            "the measured on-chip speedup needs the TPU probe"),
+    }
+    # the stand-in "kernel leg" number (>= 1.0 by construction): the
+    # HBM model, NOT the A/A measurement — see honest_label
+    paged_kernel["modeled_hbm_speedup"] = paged_kernel["attn_bytes_ratio"]
+    if kernel_on.get("tokens_s") and paged.get("tokens_s"):
+        paged_kernel["scheduler_invariance_ratio"] = round(
+            kernel_on["tokens_s"] / paged["tokens_s"], 2)
     rec = {
         "mode": "stub_churn",
         "block_size": _CHURN_BLOCK,
@@ -614,6 +690,7 @@ def run_paged_churn_comparison(n_requests: int = 192,
         "requests": n_requests,
         "per_slot": legs["per_slot"],
         "paged": paged,
+        "paged_kernel": paged_kernel,
         # the ISSUE 11 acceptance observables, hoisted to the top level
         "kv_pool_utilization": paged.get("kv_pool_utilization"),
         "blocks_shared_frac": paged.get("blocks_shared_frac"),
@@ -624,6 +701,116 @@ def run_paged_churn_comparison(n_requests: int = 192,
     if legs["per_slot"].get("tokens_s") and legs["paged"].get("tokens_s"):
         rec["paged_speedup"] = round(
             legs["paged"]["tokens_s"] / legs["per_slot"]["tokens_s"], 2)
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# paged flash-decode kernel leg (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+_PK_BLOCK = 16
+_PK_MAX_LEN = 64
+_PK_SLOTS = 4
+
+
+def _run_paged_kernel_worker(n_requests: int) -> dict:
+    """Inside the subprocess: the parent pinned
+    ``SPARKDL_SERVE_PAGED_KERNEL`` BEFORE anything traced (the jit
+    cache keys on traced shapes, not the knob — one process cannot
+    measure both legs). Drives the churn mix through a small paged
+    CPU-llama engine and returns the leg + sequential identity
+    streams."""
+    import jax
+
+    from sparkdl_tpu.models import llama as L
+    from sparkdl_tpu.serving import GenerationEngine
+
+    cfg = L.LlamaConfig.tiny()
+    model = L.LlamaModel(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           np.zeros((1, 4), np.int32))
+    workload = make_churn_workload(n_requests, vocab=cfg.vocab_size)
+
+    def make_engine():
+        return GenerationEngine.from_model(
+            model, variables, num_slots=_PK_SLOTS, max_len=_PK_MAX_LEN,
+            block_size=_PK_BLOCK, prefill_chunk=_PK_BLOCK,
+            queue_capacity=max(64, n_requests))
+
+    # identity streams: sequential fresh-engine drain — deterministic
+    # scheduling, so the two workers' streams are directly comparable
+    eng = make_engine()
+    hs = [eng.submit(p, max_new_tokens=n) for p, n in workload[:6]]
+    eng.run_until_idle()
+    streams = [h.result(1) for h in hs]
+    leg = run_engine_leg(make_engine, workload, concurrency=8)
+    gather_pos, kernel_pos = attn_positions_model(
+        workload, _PK_BLOCK, _PK_MAX_LEN)
+    return {"leg": leg, "streams": streams,
+            "attn_positions": {"gather_view": gather_pos,
+                               "kernel": kernel_pos},
+            "bytes_per_position":
+                2 * cfg.num_kv_heads * cfg.head_dim * 4 * cfg.num_layers,
+            "kernel_knob":
+                os.environ.get("SPARKDL_SERVE_PAGED_KERNEL", "auto")}
+
+
+def run_paged_kernel_comparison(n_requests: int = 12,
+                                timeout_s: float = 300.0) -> dict:
+    """ISSUE 15 CPU-llama kernel leg (healthy records): the paged
+    engine with the kernel FORCED vs the gather view, one subprocess
+    per knob value. On CPU the kernel runs through the Pallas
+    interpreter, so this leg pins ENGAGEMENT + greedy token identity;
+    the wall-clock comparison favors whichever path XLA compiles
+    natively (honest label), and the HBM-bytes claim rides the
+    deterministic attention-read model — the measured on-chip speedup
+    is the next TPU probe's job."""
+    import subprocess
+
+    from sparkdl_tpu.serving.engine import scrub_serving_env
+
+    legs = {}
+    for name, env_val in (("kernel_on", "1"), ("kernel_off", "0")):
+        env = dict(os.environ)
+        scrub_serving_env(env)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["SPARKDL_SERVE_PAGED_KERNEL"] = env_val
+        args = [sys.executable, os.path.abspath(__file__),
+                "--paged-kernel-worker", "--requests", str(n_requests)]
+        out = subprocess.run(args, env=env, capture_output=True,
+                             text=True, timeout=timeout_s)
+        if out.returncode != 0:
+            return {"mode": "llama_paged_kernel", "error":
+                    (out.stderr or out.stdout or "")[-500:]}
+        for line in reversed(out.stdout.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                legs[name] = json.loads(line)
+                break
+        else:
+            return {"mode": "llama_paged_kernel",
+                    "error": f"no JSON from {name} worker"}
+    on, off = legs["kernel_on"], legs["kernel_off"]
+    gp = on["attn_positions"]["gather_view"]
+    kp = on["attn_positions"]["kernel"]
+    bpp = on["bytes_per_position"]
+    rec = {
+        "mode": "llama_paged_kernel",
+        "block_size": _PK_BLOCK, "max_len": _PK_MAX_LEN,
+        "num_slots": _PK_SLOTS, "requests": n_requests,
+        "kernel_on": on["leg"], "kernel_off": off["leg"],
+        "token_identical": on["streams"] == off["streams"],
+        "attn_bytes": {"gather_view": gp * bpp, "kernel": kp * bpp,
+                       "ratio": round(gp / kp, 2) if kp else None},
+        "honest_label": (
+            "CPU runs the kernel through the Pallas interpreter: this "
+            "leg pins engagement + token identity; wall-clock favors "
+            "the natively compiled gather on CPU — the HBM win "
+            "(attn_bytes ratio) is measured on-chip"),
+    }
+    if on["leg"].get("tokens_s") and off["leg"].get("tokens_s"):
+        rec["cpu_speedup"] = round(
+            on["leg"]["tokens_s"] / off["leg"]["tokens_s"], 2)
     return rec
 
 
@@ -1023,6 +1210,17 @@ def run(mode: str = "llama", rows: int | None = None) -> dict:
                     n_requests=min(48, max(16, n)))
         except Exception as e:  # noqa: BLE001 — the main legs stand
             rec["spec_error"] = f"{type(e).__name__}: {e}"[:300]
+    # ISSUE 15 paged-kernel leg (real model, llama records only — the
+    # stub record's kernel evidence is the churn sub-leg above): two
+    # subprocesses pin kernel-on vs gather-view token identity + the
+    # attention-bytes model.
+    if mode != "stub" and not os.environ.get("BENCH_SKIP_PAGED_KERNEL"):
+        try:
+            rec["paged_kernel"] = run_paged_kernel_comparison(
+                n_requests=int(os.environ.get("BENCH_PAGED_KERNEL_REQUESTS",
+                                              "12")))
+        except Exception as e:  # noqa: BLE001 — the main legs stand
+            rec["paged_kernel_error"] = f"{type(e).__name__}: {e}"[:300]
     # ISSUE 14 tensor-parallel leg: a fresh subprocess on the forced
     # 8-virtual-device CPU mesh (tp in {1,2,4}) — identity, re-trace
     # and per-device-KV-bytes semantics ride BOTH the healthy llama
@@ -1051,7 +1249,15 @@ def main(argv=None) -> int:
                     help=argparse.SUPPRESS)  # internal: inside the
     # forced-virtual-device subprocess run_tp_comparison spawned
     ap.add_argument("--degrees", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--paged-kernel-worker", action="store_true",
+                    help=argparse.SUPPRESS)  # internal: one knob value
+    # per process (run_paged_kernel_comparison spawned us)
     ns = ap.parse_args(argv)
+    if ns.paged_kernel_worker:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(_run_paged_kernel_worker(ns.requests or 16)))
+        return 0
     if ns.tp_worker:
         # The parent set XLA_FLAGS/JAX_PLATFORMS in our env; latch the
         # platform before any backend initializes (the sitecustomize

@@ -127,16 +127,74 @@ def rope(x, positions, theta: float):
     return out.astype(x.dtype)
 
 
+def _gather_leaf(leaf, tables):
+    """One pool leaf ``[P, Hkv, bs, hd]`` through ``[B, MB]`` tables →
+    the dense per-slot view ``[B, Hkv, MB·bs, hd]`` (the per-leaf body
+    of :func:`_gather_view`, shared with the in-layer kernel
+    fallback)."""
+    v = leaf[tables]                       # [B, MB, Hkv, bs, hd]
+    v = jnp.transpose(v, (0, 2, 1, 3, 4))  # [B, Hkv, MB, bs, hd]
+    return v.reshape(v.shape[0], v.shape[1], -1, v.shape[4])
+
+
+def _table_blocks(tables, bi, real):
+    """Physical pool block for each logical block index ``bi``, with
+    every position whose ``real`` flag is False routed to the trash
+    block 0 — the trash-route-NEVER-clamp rule shared by the paged
+    chunk prefill and the in-layer decode/verify writes (an
+    out-of-table or pad position must land where nobody reads, never
+    slide back over a committed block). ``tables`` is indexed along
+    its last axis: a ``[MB]`` row (the chunk primitive) or
+    ``[B, MB]`` slot tables (the slot-step paths); the ``min`` clamp
+    only keeps the gather in-bounds — clamped positions are ~real and
+    route to trash."""
+    mb = tables.shape[-1]
+    safe = jnp.minimum(bi, mb - 1)
+    blk = tables[safe] if tables.ndim == 1 else \
+        jnp.take_along_axis(tables, safe, axis=1)
+    return jnp.where(real, blk, 0)
+
+
+def _dense_slot_attention(q, k_all, v_all, qpos, pads, cfg, dtype):
+    """Masked dense causal-vs-cache attention for the per-slot
+    (``slot_cur``) serving paths — ONE definition shared by the paged
+    and unpaged kernel fallbacks: query i of row r attends cache
+    columns ``[pads[r], qpos[r, i]]``. This masking math is the
+    token-identity contract the kernel-equivalence tests pin — keep it
+    single-sourced. GQA runs against the untiled cache (group axis in
+    the einsum, no ``jnp.repeat`` of K/V); masked columns get exactly
+    zero probability (exp underflow of -1e30), so table-aliased
+    garbage never perturbs live rows bitwise."""
+    B, S = qpos.shape
+    hd = cfg.head_dim
+    rep = cfg.num_heads // cfg.num_kv_heads
+    qg = q.reshape(B, cfg.num_kv_heads, rep, S, hd)
+    s = jnp.einsum("bgrqd,bgkd->bgrqk", qg, k_all) / math.sqrt(hd)
+    col = jnp.arange(k_all.shape[2])[None, None, :]
+    valid = (col <= qpos[..., None]) & (col >= pads[:, None, None])
+    s = jnp.where(valid[:, None, None], s.astype(jnp.float32), -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(dtype)
+    return jnp.einsum("bgrqk,bgkd->bgrqd", p, v_all).reshape(
+        B, cfg.num_heads, S, hd)
+
+
 class LlamaAttention(nn.Module):
     cfg: LlamaConfig
     dtype: Any = jnp.float32
     # (q,k,v,causal=...) → o; "auto" (default) resolves to the Pallas flash
     # kernel on TPU and in-model dense attention elsewhere (ops.resolve_attn_fn)
     attn_fn: Any = "auto"
+    # Mesh(('tp',)) of the tensor-parallel serving backends (ISSUE 15):
+    # a pallas_call does not partition under GSPMD, so the decode
+    # kernels dispatch under shard_map over this mesh's head axis
+    # instead (parallel.sharding.head_sharded_kernel). None everywhere
+    # else — the single-device paths are untouched.
+    kernel_mesh: Any = None
 
     @nn.compact
     def __call__(self, x, positions, decode: bool = False, pad_lens=None,
-                 first_chunk: bool = False, slot_cur=None):
+                 first_chunk: bool = False, slot_cur=None,
+                 block_tables=None):
         c, d = self.cfg, self.dtype
         B, S, _ = x.shape
         hd = c.head_dim
@@ -226,35 +284,71 @@ class LlamaAttention(nn.Module):
                 pos = jnp.maximum(qpos - pads[:, None], 0)
                 q = rope(q, pos, c.rope_theta)
                 k = rope(k, pos, c.rope_theta)
-                max_len = k_cache.value.shape[2]
-                rows_ix = jnp.arange(B)[:, None]
-                cols = jnp.where(qpos < max_len, qpos, max_len)  # OOB→drop
-                k_all = k_cache.value.at[rows_ix, :, cols, :].set(
-                    k.transpose(0, 2, 1, 3), mode="drop")
-                v_all = v_cache.value.at[rows_ix, :, cols, :].set(
-                    v.transpose(0, 2, 1, 3), mode="drop")
-                k_cache.value, v_cache.value = k_all, v_all
-                o = None
-                if S == 1:
-                    from ..ops import flash_decode as fd
-                    dec = fd.decode_fn_for(resolved_attn)
-                    if dec is not None and fd.supports(max_len):
-                        # per-row cur: each slot's HBM traffic scales
-                        # with its own fill level (the kernel's
-                        # dead-block clamp is per row).
-                        o = dec(q, k_all, v_all, slot_cur + 1, pads)
-                if o is None:
-                    qg = q.reshape(B, c.num_kv_heads, rep, S, hd)
-                    s = jnp.einsum("bgrqd,bgkd->bgrqk", qg,
-                                   k_all) / math.sqrt(hd)
-                    col = jnp.arange(max_len)[None, None, :]
-                    valid = ((col <= qpos[..., None])
-                             & (col >= pads[:, None, None]))  # [B,S,max]
-                    s = jnp.where(valid[:, None, None],
-                                  s.astype(jnp.float32), -1e30)
-                    p = jax.nn.softmax(s, axis=-1).astype(d)
-                    o = jnp.einsum("bgrqk,bgkd->bgrqd", p, v_all).reshape(
-                        B, c.num_heads, S, hd)
+                if block_tables is not None:
+                    # PAGED slot step (ISSUE 15): the cache leaves are
+                    # the SHARED pool [pool_blocks, Hkv, bs, hd] and
+                    # ``block_tables`` [B, max_blocks] names each slot's
+                    # blocks. Writes scatter through the table (the
+                    # final-chunk trash-routing rule: a position past
+                    # the table — an overhanging draft column — lands
+                    # on trash block 0 where no live range reads);
+                    # attention reads the pool THROUGH the table: via
+                    # the paged flash-decode kernel when it engages (no
+                    # gathered view exists in the program, per-step HBM
+                    # traffic O(cur) per slot), else a per-layer dense
+                    # gather view — the portable fallback, the exact
+                    # PR 11 math.
+                    bs_p = k_cache.value.shape[2]
+                    mb = block_tables.shape[1]
+                    bi = qpos // bs_p
+                    blk = _table_blocks(block_tables, bi, bi < mb)
+                    off = qpos % bs_p
+                    k_pool = k_cache.value.at[blk, :, off, :].set(
+                        k.transpose(0, 2, 1, 3).astype(
+                            k_cache.value.dtype))
+                    v_pool = v_cache.value.at[blk, :, off, :].set(
+                        v.transpose(0, 2, 1, 3).astype(
+                            v_cache.value.dtype))
+                    k_cache.value, v_cache.value = k_pool, v_pool
+                    from ..ops import paged_flash_decode as pfd
+                    o = None
+                    dec = pfd.paged_decode_fn_for(resolved_attn,
+                                                  self.kernel_mesh)
+                    if dec is not None:
+                        if pfd.supports(bs_p):
+                            o = dec(q, k_pool, v_pool, block_tables,
+                                    slot_cur, pads)
+                        elif pfd.kernel_mode() == "force":
+                            pfd.warn_fallback(
+                                f"block_size {bs_p} fails supports()")
+                    if o is None:
+                        o = _dense_slot_attention(
+                            q, _gather_leaf(k_pool, block_tables),
+                            _gather_leaf(v_pool, block_tables),
+                            qpos, pads, c, d)
+                else:
+                    max_len = k_cache.value.shape[2]
+                    rows_ix = jnp.arange(B)[:, None]
+                    cols = jnp.where(qpos < max_len, qpos,
+                                     max_len)  # OOB→drop
+                    k_all = k_cache.value.at[rows_ix, :, cols, :].set(
+                        k.transpose(0, 2, 1, 3), mode="drop")
+                    v_all = v_cache.value.at[rows_ix, :, cols, :].set(
+                        v.transpose(0, 2, 1, 3), mode="drop")
+                    k_cache.value, v_cache.value = k_all, v_all
+                    o = None
+                    if S == 1:
+                        from ..ops import flash_decode as fd
+                        dec = fd.decode_fn_for(resolved_attn,
+                                               self.kernel_mesh)
+                        if dec is not None and fd.supports(max_len):
+                            # per-row cur: each slot's HBM traffic
+                            # scales with its own fill level (the
+                            # kernel's dead-block clamp is per row).
+                            o = dec(q, k_all, v_all, slot_cur + 1, pads)
+                    if o is None:
+                        o = _dense_slot_attention(q, k_all, v_all,
+                                                  qpos, pads, c, d)
                 # falls through to the shared o_proj tail below — the
                 # serving path must ride the exact same output
                 # projection as static generate() (token identity).
@@ -316,7 +410,8 @@ class LlamaAttention(nn.Module):
                         o = None
                 if o is None and S == 1:
                     from ..ops import flash_decode as fd
-                    dec = fd.decode_fn_for(resolved_attn)
+                    dec = fd.decode_fn_for(resolved_attn,
+                                           self.kernel_mesh)
                     if dec is not None and fd.supports(k_all.shape[2]):
                         # slots < cur+1 are live (the step's own token
                         # attends to itself — the dense path's col <= row
@@ -386,14 +481,17 @@ class LlamaLayer(nn.Module):
     cfg: LlamaConfig
     dtype: Any = jnp.float32
     attn_fn: Any = "auto"
+    kernel_mesh: Any = None
 
     @nn.compact
     def __call__(self, x, positions, decode: bool = False, pad_lens=None,
-                 first_chunk: bool = False, slot_cur=None):
+                 first_chunk: bool = False, slot_cur=None,
+                 block_tables=None):
         c = self.cfg
-        x = x + LlamaAttention(c, self.dtype, self.attn_fn, name="attn")(
+        x = x + LlamaAttention(c, self.dtype, self.attn_fn,
+                               self.kernel_mesh, name="attn")(
             RMSNorm(c.rms_norm_eps, name="attn_norm")(x), positions, decode,
-            pad_lens, first_chunk, slot_cur)
+            pad_lens, first_chunk, slot_cur, block_tables)
         x = x + LlamaMLP(c, self.dtype, name="mlp")(
             RMSNorm(c.rms_norm_eps, name="mlp_norm")(x))
         return x
@@ -404,10 +502,12 @@ class LlamaModel(nn.Module):
     cfg: LlamaConfig
     dtype: Any = jnp.float32
     attn_fn: Any = "auto"  # flash on TPU, dense elsewhere; or a callable
+    kernel_mesh: Any = None  # Mesh(('tp',)) → shard_map decode kernels
 
     @nn.compact
     def __call__(self, input_ids, decode: bool = False, pad_lens=None,
-                 first_chunk: bool = False, slot_cur=None):
+                 first_chunk: bool = False, slot_cur=None,
+                 block_tables=None):
         """``first_chunk`` (decode mode, static): True ONLY when this
         apply() writes at cache index 0 — generate()'s single-call prefill
         passes it explicitly (``_prefill``). It enables the square flash
@@ -423,7 +523,18 @@ class LlamaModel(nn.Module):
         the per-slot decode step; S == k+1 is the speculative VERIFY
         window (``slot_verify_step``). The shared ``idx`` cache
         variable is neither read nor advanced (the serving engine owns
-        per-slot fill state)."""
+        per-slot fill state).
+
+        ``block_tables`` (decode mode with ``slot_cur``, ``[B,
+        max_blocks]`` int32, traced): the PAGED slot step (ISSUE 15) —
+        the provided cache leaves are the shared ``[pool_blocks, Hkv,
+        block_size, hd]`` pool and row r's logical position p lives at
+        pool position ``(block_tables[r, p // bs], p % bs)``. Writes
+        scatter through the table (positions past it trash-route to
+        block 0); attention reads the pool through the table — the
+        paged flash-decode kernel when it engages
+        (``ops.paged_flash_decode``), else a per-layer dense gather
+        view."""
         c = self.cfg
         if pad_lens is not None and not decode:
             raise ValueError(
@@ -436,14 +547,20 @@ class LlamaModel(nn.Module):
                 "slot_cur is the per-slot decode step / verify-window "
                 f"feature (decode=True); got decode={decode} — prefill a "
                 "slot via prefill_into_slot instead")
+        if block_tables is not None and slot_cur is None:
+            raise ValueError(
+                "block_tables is the paged slot-step feature: the cache "
+                "must be the shared block pool and every row needs its "
+                "own fill index — pass slot_cur (see "
+                "paged_slot_decode_step)")
         positions = jnp.arange(S)
         x = nn.Embed(c.vocab_size, c.hidden_size, dtype=self.dtype,
                      name="embed_tokens")(input_ids)
         for i in range(c.num_layers):
-            x = LlamaLayer(c, self.dtype, self.attn_fn,
+            x = LlamaLayer(c, self.dtype, self.attn_fn, self.kernel_mesh,
                            name=f"layer_{i}")(x, positions, decode,
                                               pad_lens, first_chunk,
-                                              slot_cur)
+                                              slot_cur, block_tables)
         x = RMSNorm(c.rms_norm_eps, name="final_norm")(x)
         return nn.Dense(c.vocab_size, use_bias=False, dtype=jnp.float32,
                         name="lm_head")(x)
@@ -924,12 +1041,19 @@ def slot_verify_step(model, params, cache, tokens, slot_cur, pad_lens):
 # address ONE shared pool of [pool_blocks, Hkv, block_size, hd] K/V
 # blocks per layer through a per-slot block TABLE ([max_blocks] int32,
 # traced): logical cache position p of a slot lives at pool position
-# (table[p // block_size], p % block_size). Attention reads a
-# block-gathered dense view (the portable reference layout — a TPU
-# paged-attention kernel would fuse the gather), writes scatter ONLY
-# the newly produced positions back through the table, so a shared
-# prefix block is written once and read by every slot whose table names
-# it. Program signatures depend on (num_slots, max_blocks, pool_blocks)
+# (table[p // block_size], p % block_size). The decode / verify
+# primitives route the pool + tables straight into apply(): each layer
+# writes only the newly produced positions through the table (a shared
+# prefix block is written once and read by every slot whose table
+# names it) and attends the pool THROUGH the table — the paged
+# flash-decode kernel (ops.paged_flash_decode, ISSUE 15) fuses the
+# block gather into its BlockSpec index map, so no dense per-slot view
+# exists and per-step HBM traffic is O(cur) per slot; where the kernel
+# stands down, a per-layer dense gather view keeps the portable PR 11
+# math. The chunk / whole-prompt prefill primitives keep their
+# window-bounded gather (already O(window), and prefill is
+# compute-bound, not cache-bandwidth-bound).
+# Program signatures depend on (num_slots, max_blocks, pool_blocks)
 # and the static chunk/window sizes only — tables, slots, offsets and
 # fill indices are traced, so refills, grafts and block allocation
 # never re-trace (the same no-re-trace property the per-slot
@@ -964,12 +1088,14 @@ def _gather_view(pool, tables):
     """Dense per-slot cache view through the block tables:
     ``[P, Hkv, bs, hd]`` pool leaves + ``[S, MB]`` tables →
     ``[S, Hkv, MB*bs, hd]`` rows (scalar leaves → zeros placeholders,
-    keeping the cache pytree structure apply() expects)."""
+    keeping the cache pytree structure apply() expects). Since ISSUE
+    15 the decode/verify primitives route the pool straight into
+    ``apply()`` (writes and reads go through the table in-layer, the
+    kernel fuses the gather away); this tree-level view remains the
+    REFERENCE the equivalence tests compare against."""
     def g(leaf):
         if getattr(leaf, "ndim", 0) == 4:
-            v = leaf[tables]                       # [S, MB, Hkv, bs, hd]
-            v = jnp.transpose(v, (0, 2, 1, 3, 4))  # [S, Hkv, MB, bs, hd]
-            return v.reshape(v.shape[0], v.shape[1], -1, v.shape[4])
+            return _gather_leaf(leaf, tables)
         return jnp.zeros((), jnp.int32)
 
     return jax.tree_util.tree_map(g, pool)
@@ -992,30 +1118,24 @@ def paged_slot_decode_step(model, params, pool, tables, tokens, slot_cur,
     whatever their table names at the frontier — the engine parks those
     entries on the trash block, so the masked garbage is contained.
     Returns ``(next_tokens [num_slots] int32, pool)``.
+
+    Since ISSUE 15 the pool rides into ``apply()`` DIRECTLY with the
+    block tables (no tree-level ``_gather_view`` / scatter-back): each
+    layer writes its one new position through the table and attends the
+    pool through the table — via the paged flash-decode kernel when it
+    engages (``ops.paged_flash_decode``: the program holds NO
+    ``[S, Hkv, max_blocks·bs, hd]`` gather and per-step HBM traffic is
+    O(cur) per slot), else a per-layer dense gather view with the exact
+    PR 11 math (masked garbage contributes exactly-zero probability, so
+    committed tokens are unchanged either way).
     """
-    bs = _pool_block_size(pool)
-    dense = _gather_view(pool, tables)
-    logits, mut = model.apply({"params": params, "cache": dense},
+    logits, mut = model.apply({"params": params, "cache": pool},
                               tokens[:, None], decode=True,
                               pad_lens=pad_lens, slot_cur=slot_cur,
-                              mutable=["cache"])
-    blk = jnp.take_along_axis(tables, (slot_cur // bs)[:, None],
-                              axis=1)[:, 0]               # [S] physical
-    off = slot_cur % bs
-
-    def scatter(pool_leaf, dense_leaf):
-        if getattr(pool_leaf, "ndim", 0) != 4:
-            return pool_leaf
-        new = jnp.take_along_axis(
-            dense_leaf, slot_cur[:, None, None, None],
-            axis=2)[:, :, 0, :]                           # [S, Hkv, hd]
-        return pool_leaf.at[blk, :, off, :].set(
-            new.astype(pool_leaf.dtype))
-
-    pool = jax.tree_util.tree_map(scatter, pool, mut["cache"])
+                              block_tables=tables, mutable=["cache"])
     nxt = _sample(logits[:, -1].astype(jnp.float32), rng, temperature,
                   top_k, top_p)
-    return nxt, pool
+    return nxt, mut["cache"]
 
 
 @functools.partial(jax.jit, static_argnames=("model",),
@@ -1028,45 +1148,25 @@ def paged_slot_verify_step(model, params, pool, tables, tokens, slot_cur,
     shared pool, with the draft window's growth blocks allocated UP
     FRONT by the engine (``ensure_block_for`` per draft position — a
     position whose block the pool could not serve routes to the trash
-    block 0 and its proposal is never committed). Attention reads the
-    block-gathered dense view exactly like ``paged_slot_decode_step``;
-    reject is the same pure host-side ``cur`` non-advance — the
+    block 0 and its proposal is never committed). The k+1 writes go
+    through the tables in-layer (overhanging positions trash-route —
+    same rule as the chunk primitive: never clamp onto live blocks) and
+    attention reads the pool through the tables exactly like
+    ``paged_slot_decode_step`` — the paged flash-decode kernel covers
+    this S = k+1 window too (query i attends ``[pads[r],
+    slot_cur[r]+i]``), with the per-layer gather view as the fallback.
+    Reject is the same pure host-side ``cur`` non-advance — the
     misspeculated rows are garbage past the frontier, overwritten
     (or trash-routed) before any attention reads them. Compiled ONCE
     per (num_slots, max_blocks, pool_blocks, k+1); tables/fill indices
     traced, so allocation, grafts and refills never re-trace it.
     Returns ``(proposals [num_slots, k+1] int32, pool)``."""
-    bs = _pool_block_size(pool)
-    dense = _gather_view(pool, tables)
-    logits, mut = model.apply({"params": params, "cache": dense},
+    logits, mut = model.apply({"params": params, "cache": pool},
                               tokens, decode=True, pad_lens=pad_lens,
-                              slot_cur=slot_cur, mutable=["cache"])
-    kp1 = tokens.shape[1]
-    pos = slot_cur[:, None] + jnp.arange(kp1)[None, :]   # [S, k+1]
-    bi = pos // bs
-    mb = tables.shape[1]
-    # Positions past the table route to trash block 0 (same rule as the
-    # chunk primitive): a near-full row's overhanging draft columns
-    # land where nobody reads instead of clamping onto live blocks.
-    real = bi < mb
-    blk = jnp.where(real, jnp.take_along_axis(
-        tables, jnp.minimum(bi, mb - 1), axis=1), 0)
-    off = pos % bs
-
-    def scatter(pool_leaf, dense_leaf):
-        if getattr(pool_leaf, "ndim", 0) != 4:
-            return pool_leaf
-        view_len = dense_leaf.shape[2]
-        new = jnp.take_along_axis(
-            dense_leaf, jnp.minimum(pos, view_len - 1)[:, None, :, None],
-            axis=2)                                      # [S, Hkv, k+1, hd]
-        new = jnp.moveaxis(new, 1, 2)                    # [S, k+1, Hkv, hd]
-        return pool_leaf.at[blk, :, off, :].set(
-            new.astype(pool_leaf.dtype))
-
-    pool = jax.tree_util.tree_map(scatter, pool, mut["cache"])
+                              slot_cur=slot_cur, block_tables=tables,
+                              mutable=["cache"])
     props = jnp.argmax(logits.astype(jnp.float32), axis=-1)
-    return props.astype(jnp.int32), pool
+    return props.astype(jnp.int32), mut["cache"]
 
 
 @functools.partial(
@@ -1134,7 +1234,7 @@ def paged_prefill_chunk_into_slot(model, params, chunk_ids, pool,
     # one decode block; decode's first write lands at the frontier
     # before any attention can read it — the PR 9 invariant).
     real = (pos < offset + n_valid) & (bi < mb)
-    blk = jnp.where(real, table_row[jnp.minimum(bi, mb - 1)], 0)
+    blk = _table_blocks(table_row, bi, real)
     off = pos % bs
 
     def scatter(pool_leaf, dense_leaf):

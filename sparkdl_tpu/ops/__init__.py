@@ -3,3 +3,7 @@
 from .flash_attention import auto_attn_fn, flash_attention, resolve_attn_fn
 
 __all__ = ["flash_attention", "auto_attn_fn", "resolve_attn_fn"]
+
+# flash_decode / paged_flash_decode import lazily at their call sites
+# (models.llama) — importing them here would pull pallas.tpu into every
+# `from sparkdl_tpu import ops` even on jax-free paths.

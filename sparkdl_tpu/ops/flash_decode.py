@@ -199,7 +199,41 @@ def flash_decode(q, k_cache, v_cache, cur, pad_lens=None, *,
     return o.reshape(b, hq, 1, d)
 
 
-def decode_fn_for(attn_fn):
+#: sharded decode-kernel dispatch under a ``Mesh(('tp',))``: ``0`` off
+#: (dense cache attention, the pre-PR-15 tp behavior), ``1`` force (any
+#: platform — the CPU virtual-device tests), unset/``auto`` = on when
+#: the backend is TPU (the platform where the kernel pays).
+TP_KERNEL_ENV = "SPARKDL_SERVE_TP_KERNEL"
+
+
+def tri_state_env(name: str) -> str:
+    """Shared knob parser for the decode-kernel levers
+    (``SPARKDL_SERVE_TP_KERNEL`` here, ``SPARKDL_SERVE_PAGED_KERNEL``
+    in ``ops.paged_flash_decode``): ``0/off/false`` → ``"off"``,
+    ``1/on/force/true`` → ``"force"``, anything else → ``"auto"``.
+    One accepted-spelling table, so the sibling knobs cannot drift."""
+    import os
+    v = os.environ.get(name, "auto").strip().lower()
+    if v in ("0", "off", "false"):
+        return "off"
+    if v in ("1", "on", "force", "true"):
+        return "force"
+    return "auto"
+
+
+def _tp_kernel_mode() -> str:
+    return tri_state_env(TP_KERNEL_ENV)
+
+
+def _tp_kernel_on() -> bool:
+    mode = _tp_kernel_mode()
+    if mode != "auto":
+        return mode == "force"
+    from ..utils.platform import is_tpu_backend
+    return is_tpu_backend()
+
+
+def decode_fn_for(attn_fn, mesh=None):
     """Call-site resolver (``models.llama.LlamaAttention``): the cache
     decode kernel pairs with the flash prefill kernel — when the model's
     resolved ``attn_fn`` is :func:`ops.flash_attention.flash_attention`
@@ -207,10 +241,24 @@ def decode_fn_for(attn_fn):
     steps run through :func:`flash_decode`; any other attention (dense,
     ring/Ulysses — sequence-sharded KV doesn't apply to a replicated
     cache) keeps the in-model dense cache path. Disable explicitly with
-    ``SPARKDL_FLASH_DECODE=0`` (ablation lever for the bench)."""
+    ``SPARKDL_FLASH_DECODE=0`` (ablation lever for the bench).
+
+    ``mesh`` (the serving backends' ``Mesh(('tp',))``): a pallas_call
+    does not partition under GSPMD, so the tensor-parallel backends pin
+    ``attn_fn=None`` — the kernel instead dispatches under ``shard_map``
+    over the mesh's head axis (``parallel.sharding
+    .head_sharded_kernel``; per-head attention needs no collective),
+    gated by ``SPARKDL_SERVE_TP_KERNEL`` (auto = TPU only: the
+    interpret-mode kernel would slow CPU virtual-device runs for
+    nothing)."""
     import os
     if os.environ.get("SPARKDL_FLASH_DECODE", "1") == "0":
         return None
+    if mesh is not None:
+        if not _tp_kernel_on():
+            return None
+        from ..parallel.sharding import head_sharded_kernel
+        return head_sharded_kernel(flash_decode, mesh)
     from .flash_attention import adaptive_attention, flash_attention
     if attn_fn is flash_attention or attn_fn is adaptive_attention:
         return flash_decode

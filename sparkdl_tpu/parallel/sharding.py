@@ -11,6 +11,7 @@ the specs into ICI collectives; no manual comms anywhere.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import re
 from typing import Any, Callable, Sequence
 
@@ -204,6 +205,39 @@ def divisible_rules(base_rules: Callable, mesh: Mesh) -> Callable:
 
     rules.match_str = getattr(base_rules, "match_str", None)
     return rules
+
+
+def head_sharded_kernel(fn, mesh: Mesh, axis: str = "tp"):
+    """Wrap a flash-decode-style kernel in ``shard_map`` over the
+    mesh's head axis (ISSUE 15): a ``pallas_call`` does not partition
+    under GSPMD, which is why the tensor-parallel serving backends rode
+    dense cache attention — but per-head attention needs no collective,
+    so each device can run the UNMODIFIED kernel on its local head
+    shard. The first three operands (q / K cache-or-pool / V, head axis
+    at dim 1) shard over ``axis``; every trailing operand (block
+    tables, fill indices, pad lengths) is replicated; the output shards
+    like q. Works for both :func:`ops.flash_decode.flash_decode`
+    (``[B, H*, L, d]`` cache operands) and
+    :func:`ops.paged_flash_decode.paged_flash_decode`
+    (``[pool, Hkv, bs, d]`` pool operands) — dim 1 is the head axis in
+    both layouts. GQA stays exact per shard: the serving layout
+    requires ``tp`` to divide both head counts
+    (:func:`serving_tp_layout`), so each shard keeps the global
+    Hq/Hkv ratio."""
+    from jax.experimental.shard_map import shard_map
+
+    spec_h = P(None, axis, None, None)
+
+    def wrapped(q, k, v, *rest, **kw):
+        inner = functools.partial(fn, **kw) if kw else fn
+        return shard_map(
+            inner, mesh=mesh,
+            in_specs=(spec_h, spec_h, spec_h) + tuple(P() for _ in rest),
+            out_specs=spec_h, check_rep=False)(q, k, v, *rest)
+
+    wrapped.__name__ = f"head_sharded_{getattr(fn, '__name__', 'kernel')}"
+    wrapped.__wrapped__ = fn
+    return wrapped
 
 
 # ---------------------------------------------------------------------------

@@ -774,10 +774,15 @@ def _tp_setup(self, model, tp: int, mesh):
     the cache/pool allocation hooks see the mesh: validate the
     :func:`parallel.sharding.serving_tp_layout` SpecLayout against the
     model's head counts, build/adopt the ``Mesh(('tp',))``, derive the
-    placement shardings, and pin dense in-model attention (the Pallas
-    flash kernels do not partition under GSPMD — a sharded
-    paged-attention kernel is the named follow-on, not a silent
-    correctness risk). The FOUR jitted donated-cache slot primitives
+    placement shardings, and pin dense in-model PREFILL attention (a
+    pallas_call does not partition under GSPMD). The DECODE kernels are
+    no longer lost to that constraint: ``kernel_mesh`` hands the mesh
+    to the model, and the flash-decode / paged-flash-decode dispatch
+    runs under ``shard_map`` over the head axis instead
+    (``parallel.sharding.head_sharded_kernel`` — gated by
+    ``SPARKDL_SERVE_TP_KERNEL``, auto = TPU only; the ISSUE 15 closure
+    of ROADMAP item 3's kernel gap). The FOUR jitted donated-cache slot
+    primitives
     (and their paged variants) then run UNCHANGED: GSPMD propagates
     the input shardings through every scatter/gather, keeps the cache
     head-sharded across donation, inserts the Megatron
@@ -798,8 +803,10 @@ def _tp_setup(self, model, tp: int, mesh):
     self._replicated = NamedSharding(self.mesh, layout.replicated)
     # Pallas flash kernels do not partition under GSPMD: pin the dense
     # in-model attention for every sharded program (the "auto" default
-    # would pick flash on TPU and fail to partition).
-    return model.clone(attn_fn=None)
+    # would pick flash on TPU and fail to partition). Decode steps get
+    # the kernels back via kernel_mesh — the model dispatches them
+    # under shard_map over the head axis (ISSUE 15).
+    return model.clone(attn_fn=None, kernel_mesh=self.mesh)
 
 
 def _tp_finish(self):

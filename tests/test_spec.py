@@ -417,12 +417,77 @@ class TestSpecOnCpu:
         assert GLOBAL_COMPILE_CACHE.signatures(
             "serve_decode_step") == sig_d
 
+    def test_paged_spec_preemption_resume_fast_twin(self):
+        """Lean twin of the slow static-anchored test below (the
+        tier-1 headroom rule — ISSUE 15 added the interpret-mode
+        kernel suite, this buys the seconds back): same contract — a
+        mid-decode preemption-resume plus a radix graft under
+        speculation must not change the streams — but the reference is
+        the SAME engine config run without the preemption (whose
+        static-generate() identity the other fast spec/paging tests
+        pin), so the twin skips the two extra generate() programs, and
+        a 1-layer model halves the compile cost. The slow test keeps
+        the static anchor on the full tiny model."""
+        import dataclasses
+
+        import jax
+
+        from sparkdl_tpu.core.runtime import GLOBAL_COMPILE_CACHE
+        from sparkdl_tpu.models import llama as L
+
+        cfg = dataclasses.replace(L.LlamaConfig.tiny(), num_layers=1)
+        model = L.LlamaModel(cfg)
+        variables = model.init(jax.random.PRNGKey(0),
+                               np.zeros((1, 4), np.int32))
+        rng = np.random.RandomState(7)
+        new = 12
+        head = rng.randint(0, cfg.vocab_size, 16).tolist()  # 2 blocks
+        pa = head + rng.randint(0, cfg.vocab_size, 3).tolist()
+        pb = head + rng.randint(0, cfg.vocab_size, 6).tolist()
+
+        def make_engine(prov):
+            return GenerationEngine.from_model(
+                model, variables, num_slots=2, max_len=64,
+                prefill_chunk=8, block_size=8, prefill_budget=16,
+                spec_k=3, draft_provider=prov)
+
+        ref_eng = make_engine(HistoryDraft())  # clean drained streams
+        refs = []
+        for p in (pa, pb):
+            h = ref_eng.submit(p, max_new_tokens=new)
+            ref_eng.run_until_idle()
+            refs.append(h.result(1))
+
+        prov = HistoryDraft()
+        prov.observe(pa, refs[0])
+        prov.observe(pb, refs[1])
+        eng = make_engine(prov)
+        ha = eng.submit(pa, max_new_tokens=new)
+        eng.step()  # 2 of pa's 3 chunks (budget 16)
+        eng.step()  # final chunk + first token (+ a verify window)
+        sig_v = GLOBAL_COMPILE_CACHE.signatures("serve_verify_step")
+        eng.step()
+        assert eng.snapshot()["spec_verifies"] >= 1
+        assert ha.state == "running" and 0 < len(ha.tokens) < new
+        eng._preempt_newest([(ha.slot, ha)])
+        hb = eng.submit(pb, max_new_tokens=new)  # grafts pa's head
+        eng.run_until_idle()
+        assert ha.result(1) == refs[0]
+        assert hb.result(1) == refs[1]
+        snap = eng.snapshot()
+        assert snap["preemptions"] == 1
+        assert GLOBAL_COMPILE_CACHE.signatures(
+            "serve_verify_step") == sig_v
+
+    @pytest.mark.slow
     def test_paged_spec_identity_graft_and_preemption_resume(self):
         """Paged: speculative decode through the block tables with a
         radix graft AND a mid-decode preemption-resume — the resumed
         stream and the grafted stream must both stay bit-identical to
         static generate(), with zero verify re-traces through
-        allocation, graft, preempt and resume."""
+        allocation, graft, preempt and resume. (Slow: the fast twin
+        above pins the same contract engine-vs-engine; this keeps the
+        static anchor.)"""
         from sparkdl_tpu.core.runtime import GLOBAL_COMPILE_CACHE
 
         cfg, model, variables = self._model()

@@ -403,6 +403,40 @@ class TestTpEngineOnCpu:
         finally:
             telemetry.reset()
 
+    def test_tp2_sharded_decode_kernels_token_identity(self, monkeypatch):
+        """ISSUE 15: with ``SPARKDL_SERVE_TP_KERNEL=1`` (forced — auto
+        is TPU-only) the tp engines stop riding dense cache attention:
+        the paged backend dispatches the paged flash-decode kernel and
+        the unpaged backend the dense flash-decode kernel, each under
+        ``shard_map`` over the head axis — and the greedy streams stay
+        bit-identical to static ``generate()``. Odd slot counts keep
+        the jit signatures private to this test (the cache keys on
+        traced shapes, not the env knob — a kernel-off program traced
+        by the other tp tests must not be reused here)."""
+        monkeypatch.setenv("SPARKDL_SERVE_TP_KERNEL", "1")
+        cfg, model, variables = _tiny_model()
+        rng = np.random.RandomState(19)
+        new = 6
+        prompts = [rng.randint(0, cfg.vocab_size, n).tolist()
+                   for n in (5, 11, 8)]
+        refs = _static_refs(model, variables, prompts, new, 128)
+
+        # paged: block_size 8 passes the paged supports(); auto mode
+        # engages because the sharded dense dispatch is forced on
+        engp = GenerationEngine.from_model(
+            model, variables, num_slots=3, max_len=48, block_size=8,
+            prefill_chunk=8, tp=2)
+        hs = [engp.submit(p, max_new_tokens=new) for p in prompts]
+        engp.run_until_idle()
+        assert [h.result(1) for h in hs] == refs
+        # unpaged: max_len 128 = the dense kernel's KV-block multiple
+        engd = GenerationEngine.from_model(
+            model, variables, num_slots=3, max_len=128,
+            prefill_chunk=8, tp=2)
+        hs = [engd.submit(p, max_new_tokens=new) for p in prompts]
+        engd.run_until_idle()
+        assert [h.result(1) for h in hs] == refs
+
     def test_tp_gauges_zero_registration_when_plane_off(self):
         from sparkdl_tpu.runner import telemetry
         from sparkdl_tpu.serving import StubBackend
