@@ -43,7 +43,7 @@ BENCH_FEAT_ROWS (1024), BENCH_FEAT_BATCH (128), BENCH_FEAT_MODEL
 BENCH_GEN_BATCH (8), BENCH_GEN_PROMPT (128), BENCH_GEN_NEW (64),
 BENCH_PEAK_TFLOPS (197 — v5e bf16 peak; set 275 for v4 pairs etc.),
 BENCH_SKIP_FEATURIZER / BENCH_SKIP_BERT / BENCH_SKIP_GEN /
-BENCH_SKIP_FLASH,
+BENCH_SKIP_FLASH / BENCH_SKIP_ELASTIC,
 BENCH_FAKE_HANG_S (test knob: every worker sleeps this long first, to
 simulate the hung-backend outage in hardening tests).
 
@@ -1097,6 +1097,32 @@ def _load_script_module(name: str):
     return mod
 
 
+def _elastic_block(budget=None) -> dict:
+    """Elastic-supervision evidence (ISSUE 16) for ``failure_stats``: the
+    jax-free policy leg from ``scripts/elastic_smoke.py`` — a stdlib
+    worker gang loses one rank PERMANENTLY (``decimate``), the supervisor
+    shrinks it without burning restart budget, and the batch ledger is
+    audited for exactly-once replay across the resize. Zero jax in the
+    supervisor or workers, so the block rides ``backend_unavailable``
+    records too. ``BENCH_SKIP_ELASTIC=1`` skips; the leg costs ~30s of
+    gang relaunches, so it also yields when the wall budget is nearly
+    spent; any failure is reported in-band — this leg must never kill a
+    bench record."""
+    if os.environ.get("BENCH_SKIP_ELASTIC"):
+        return {"skipped": "env"}
+    if budget is not None and budget.remaining() < 90:
+        return {"skipped": "budget",
+                "detail": f"{budget.remaining():.0f}s left"}
+    t0 = time.monotonic()
+    try:
+        return _load_script_module("elastic_smoke.py").policy_block()
+    except Exception as e:  # noqa: BLE001 — in-band, never fatal
+        return {"error": f"{type(e).__name__}: {e}"[:300]}
+    finally:
+        if budget is not None:
+            budget.leg_times["elastic"] = round(time.monotonic() - t0, 1)
+
+
 def _worker_serve() -> dict:
     """Continuous-batching serving leg (ISSUE 8): aggregate tokens/s at
     closed-loop concurrency 1/8/32 through ``serving.GenerationEngine``
@@ -1511,6 +1537,9 @@ def main():
             err_extra.update(_serve_headline(serve_rec))
         elif serve_err:
             err_extra["serving_error"] = serve_err
+        # Elastic policy evidence survives the outage too (ISSUE 16):
+        # supervisor + stdlib workers, no jax anywhere in the leg.
+        err_extra["failure_stats"] = {"elastic": _elastic_block(budget)}
         err_extra["budget"] = {"wall_s": budget.wall_s,
                                "spent_s": round(budget.spent(), 1),
                                "leg_times_s": dict(budget.leg_times)}
@@ -1659,6 +1688,9 @@ def main():
             fs["faults_injected"] += int(ws.get("faults_injected") or 0)
             fs["last_failure_kind"] = (ws.get("last_failure_kind")
                                        or fs["last_failure_kind"])
+    # Elastic gang supervision (ISSUE 16): resizes / final world size /
+    # exactly-once verdict from the jax-free policy leg.
+    fs["elastic"] = _elastic_block(budget)
     extra["failure_stats"] = fs
     extra["budget"] = {"wall_s": budget.wall_s,
                        "spent_s": round(budget.spent(), 1),

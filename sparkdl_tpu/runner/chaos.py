@@ -50,6 +50,14 @@ Kinds (what happens when a fault fires):
 - ``corrupt`` — truncate + bit-flip the newest checkpoint under the
   firing site's ``path`` (``checkpoint_restore`` only; exercises manifest
   verification and rollback-to-verified-step)
+- ``decimate`` — ``SIGKILL`` the calling process AND leave a persistent
+  per-``(rank, world size)`` death marker in the plan ``state_dir``: the
+  rank's *slot* stays dead, so every later attempt at the same world size
+  re-kills it on its first ``fire()`` call (modeling a preempted machine
+  that does not come back — the elastic supervisor's shrink trigger,
+  ISSUE 16). A relaunch at a *different* world size is a fresh
+  allocation and the marker does not apply; deleting the marker models
+  recovered capacity (the grow-back probe then succeeds).
 
 Triggers are deterministic: ``at_step=N`` fires when the hook's step equals
 N; ``prob=p`` draws from a per-fault ``RandomState`` seeded from
@@ -57,7 +65,11 @@ N; ``prob=p`` draws from a per-fault ``RandomState`` seeded from
 identically. ``once=True`` (default) fires at most once — and when the plan
 carries a ``state_dir``, "once" persists across process restarts via marker
 files, so a relaunched gang does not re-inject the same preemption forever
-(``supervise`` provides a state dir automatically).
+(``supervise`` provides a state dir automatically). ``decimate`` inverts
+that contract: its ``state_dir`` marker makes the fault KEEP firing (same
+rank, same world size) across relaunches — persistence means the slot
+stays dead, not that the fault is spent; without a ``state_dir`` it
+degrades to a plain per-process ``sigkill``.
 
 This module keeps its import surface stdlib+numpy-light so the supervising
 launcher can import it without dragging in jax.
@@ -82,7 +94,8 @@ CHAOS_ENV = "SPARKDL_CHAOS"
 SITES = ("step_start", "checkpoint_save", "batch_fetch", "collective",
          "worker", "decode", "dispatch", "checkpoint_restore",
          "data_fetch")
-KINDS = ("preempt", "fatal", "nan", "hang", "sigkill", "corrupt", "poison")
+KINDS = ("preempt", "fatal", "nan", "hang", "sigkill", "corrupt", "poison",
+         "decimate")
 
 
 class InjectedFault(RuntimeError):
@@ -120,6 +133,13 @@ def announce_injection(what: str = "a deliberate retryable failure"):
 
 def _this_rank() -> int:
     return int(os.environ.get("SPARKDL_PROCESS_ID", "0"))
+
+
+def _this_world() -> int:
+    try:
+        return int(os.environ.get("SPARKDL_NUM_PROCESSES", "1"))
+    except ValueError:
+        return 1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -222,6 +242,36 @@ class FaultPlan:
             return None
         return os.path.join(self.state_dir, f"chaos_fault{idx}.fired")
 
+    # -- decimate: persistent dead-slot markers ---------------------------
+    def decimate_marker(self, rank: int,
+                        world: int | None = None) -> str | None:
+        """Path of the dead-slot marker for ``rank`` within a ``world``-
+        sized allocation (None without a ``state_dir``). Scoped to the
+        WORLD SIZE, not just the rank: a relaunch at a different size is
+        a fresh slot allocation — a gang shrunk from 4 to 3 must not
+        re-kill its (new, healthy) rank 2 just because slot 2 of the
+        4-slot allocation died. Tests delete this file to model
+        recovered capacity."""
+        if not self.state_dir:
+            return None
+        world = _this_world() if world is None else int(world)
+        return os.path.join(self.state_dir,
+                            f"chaos_decimated_rank{rank}_np{world}")
+
+    def _slot_decimated(self) -> bool:
+        marker = self.decimate_marker(_this_rank())
+        return bool(marker and os.path.exists(marker))
+
+    def _mark_decimated(self):
+        marker = self.decimate_marker(_this_rank())
+        if marker:
+            try:
+                os.makedirs(self.state_dir, exist_ok=True)
+                with open(marker, "w") as f:
+                    f.write(str(time.time()))
+            except OSError:
+                pass  # no marker: decimate degrades to a one-off sigkill
+
     def _already_fired(self, idx: int) -> bool:
         if self._fired[idx]:
             return True
@@ -245,7 +295,22 @@ class FaultPlan:
         poisoned). Raising kinds raise; ``sigkill`` does not return.
         ``path``: site-local filesystem context (the checkpoint directory
         at ``checkpoint_restore`` — the ``corrupt`` kind damages the
-        newest step under it)."""
+        newest step under it).
+
+        ``once`` markers make a fired fault STAY fired across relaunches;
+        a ``decimate`` dead-slot marker is the inverse — it makes the kill
+        RECUR: any ``fire()`` call (regardless of site or trigger) from a
+        rank whose slot is marked dead at the current world size re-kills
+        the process immediately."""
+        if any(f.kind == "decimate" for f in self.faults) \
+                and self._slot_decimated():
+            # This slot already died at this world size and never came
+            # back — the process must not get to run even one step, no
+            # matter which site consulted the plan first.
+            _record_fault(site, "decimate", step)
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os.kill(os.getpid(), signal.SIGKILL)
         out = batch
         for idx, f in enumerate(self.faults):
             if f.site != site:
@@ -260,6 +325,10 @@ class FaultPlan:
             elif self._rng(idx).random_sample() >= f.prob:
                 continue
             self._mark_fired(idx)
+            if f.kind == "decimate":
+                # Marker BEFORE the kill: the slot must read as dead to
+                # every later attempt even though SIGKILL never returns.
+                self._mark_decimated()
             _record_fault(site, f.kind, step)
             out = _execute(f, site, step, out, path=path)
         return out
@@ -311,7 +380,7 @@ def _execute(f: Fault, site: str, step, batch, path: str | None = None):
     if f.kind == "hang":
         time.sleep(f.hang_s)
         return batch
-    if f.kind == "sigkill":
+    if f.kind in ("sigkill", "decimate"):
         sys.stdout.flush()
         sys.stderr.flush()
         os.kill(os.getpid(), signal.SIGKILL)

@@ -404,7 +404,17 @@ def append_ledger(step: int, cursor: dict | None):
     supersedes it, so audits take the last entry per step). Append-mode:
     survives SIGKILL up to the last dispatched step and accumulates
     ACROSS restart attempts (the exactly-once audit needs all lineages).
-    No-op unless ``SPARKDL_BATCH_LEDGER`` names a directory."""
+    No-op unless ``SPARKDL_BATCH_LEDGER`` names a directory.
+
+    Each line carries the WORLD SIZE in force when the batch was drawn
+    (ISSUE 16): an elastic resize shows up in the ledger as the ``world``
+    column changing mid-run, so the exactly-once audit can see — not
+    infer — where the gang shrank or grew. The cursor itself is
+    world-size-agnostic (it tracks the GLOBAL batch stream; per-rank
+    slices are cut at draw time from the live env), which is what makes
+    replay at a different world size correct at all — but only for
+    ``shard=True`` datasets over the global stream; per-rank *distinct*
+    sources cannot be resharded and keep fixed-size semantics."""
     d = os.environ.get(LEDGER_ENV)
     if not d or cursor is None:
         return
@@ -421,6 +431,7 @@ def append_ledger(step: int, cursor: dict | None):
                 # batch that was quarantined in between) from a replay
                 # divergence (the actual exactly-once violation).
                 "skip_list": cursor.get("skip_list") or [],
+                "world": int(os.environ.get("SPARKDL_NUM_PROCESSES", "1")),
                 "t": round(time.time(), 3)}) + "\n")
     except OSError:
         pass  # a torn-down tmpdir must not kill the train loop

@@ -431,11 +431,16 @@ _MERGE_TAIL_BYTES = 1 << 20  # per-rank read cap when merging timelines
 # is service degradation the run survived — timeline narrative a
 # postmortem should show, never failure evidence that could outrank the
 # fault that actually killed the gang.
+# ISSUE 16 adds the elastic narrative: a gang that shrank (or grew back)
+# around a permanently dead rank (`gang_resized`, supervisor-side) and a
+# checkpoint re-laid-out onto a different mesh at restore
+# (`checkpoint_resharded`) both SURVIVED — degraded capacity, not failure.
 _DEGRADATION_EVENTS = ("retry", "quarantine", "checkpoint_rollback",
                        "checkpoint_quarantine", "train_resume",
                        "train_batch_quarantined", "train_batch_skipped",
                        "unverified_data_cursor", "slo_breach",
-                       "slo_recovered")
+                       "slo_recovered", "gang_resized",
+                       "checkpoint_resharded")
 
 
 def atomic_write_json(path: str, obj) -> str:

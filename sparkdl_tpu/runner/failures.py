@@ -16,9 +16,39 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import os
 import re
 
 log = logging.getLogger("sparkdl_tpu.runner")
+
+# Elastic gang supervision (ISSUE 16). Defined HERE (jax-free policy
+# module) because both sides of the contract read it: the supervisor
+# (``launcher.supervise`` decides whether a permanently dead rank shrinks
+# the gang) and the workers (``CheckpointManager.restore`` decides
+# whether a topology-mismatched checkpoint reshards or refuses).
+ELASTIC_ENV = "SPARKDL_ELASTIC"
+ELASTIC_MIN_ENV = "SPARKDL_ELASTIC_MIN_NP"
+
+_TRUTHY = ("1", "true", "yes", "on")
+
+
+def elastic_enabled(env: dict | None = None) -> bool:
+    """True when elastic resize is armed — the caller's env dict wins
+    over the process environment (the launcher's merge order)."""
+    raw = (env or {}).get(ELASTIC_ENV) or os.environ.get(ELASTIC_ENV, "")
+    return raw.strip().lower() in _TRUTHY
+
+
+def elastic_min_np(env: dict | None = None) -> int:
+    """The world-size floor a shrinking gang must not pass (default 1 —
+    a single survivor still finishes the job). Malformed values degrade
+    to the default: a bad knob must not kill the supervisor."""
+    raw = (env or {}).get(ELASTIC_MIN_ENV) \
+        or os.environ.get(ELASTIC_MIN_ENV, "")
+    try:
+        return max(1, int(raw))
+    except (TypeError, ValueError):
+        return 1
 
 # gRPC/XLA status words that indicate the *platform* (not the program) broke.
 # UNAVAILABLE/ABORTED/CANCELLED: backend or coordination flake.

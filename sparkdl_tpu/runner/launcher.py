@@ -136,6 +136,12 @@ class SuperviseResult:
     # (telemetry.aggregate_snapshots) — per-stage busy-seconds/rows/bytes
     # summed across ranks. None when no rank exported metrics.
     metrics: dict | None = None
+    # Elastic gang supervision (ISSUE 16): world-size changes the
+    # supervisor made (shrinks around permanently dead ranks, grow-back
+    # probes, probe reverts) and the world size of the attempt that
+    # finally succeeded.
+    resizes: int = 0
+    final_np: int | None = None
 
     @property
     def last_failure_kind(self) -> str | None:
@@ -174,6 +180,44 @@ def _record_batch_quarantine():
         metrics_lib.run_stats.record_batch_quarantine()
     except Exception:
         pass
+
+
+def _record_resize(from_np: int, to_np: int, rank: int | None = None):
+    """run_stats + telemetry counters for an elastic resize (ISSUE 16).
+    run_stats follows the lazy-import rule above; the ``gang_resizes``
+    telemetry counter is stdlib (telemetry_lib is already a supervisor
+    import) and counts regardless of the exporter being armed."""
+    try:
+        from . import metrics as metrics_lib
+        metrics_lib.run_stats.record_resize(from_np, to_np, rank=rank)
+    except Exception:
+        pass
+    try:
+        telemetry_lib.registry().counter("gang_resizes").inc()
+    except Exception:
+        pass
+
+
+def _dead_rank_evidence(status: str, info: dict, err: GangFailure) \
+        -> int | None:
+    """The rank the failure evidence names as (the first) dead, or None
+    when the evidence doesn't implicate one specific rank — the elastic
+    shrink trigger correlates on this across consecutive attempts, the
+    same way poison-batch quarantine correlates on the batch index.
+
+    Only RETRYABLE verdicts qualify: a fatal classification means the
+    program is the problem (user bug, poison data) and relaunching
+    smaller would just re-run the bug on fewer chips. A ``timeout`` has
+    no per-rank attribution (the whole gang missed the deadline)."""
+    if err.kind != "retryable":
+        return None
+    if status == "failed":
+        ranks = (info or {}).get("ranks") or []
+        return int(ranks[0]) if ranks else None
+    if status == "hung":
+        rank = (info or {}).get("rank")
+        return int(rank) if rank is not None else None
+    return None
 
 
 def free_port() -> int:
@@ -423,11 +467,23 @@ def _heartbeat_ages(heartbeat_dir: str, np: int,
 
 
 def _clear_heartbeats(heartbeat_dir: str, np: int):
-    for rank in range(np):
-        try:
-            os.unlink(os.path.join(heartbeat_dir, f"rank{rank}.hb"))
-        except OSError:
-            pass
+    """Remove ALL ``rank*.hb`` files, not just ``range(np)``: after an
+    elastic shrink (ISSUE 16) the new, smaller attempt would otherwise
+    leave the dead rank's old beat from the larger previous attempt on
+    disk — stale liveness evidence the watchdog scan (and any postmortem
+    reading the dir) must never see. ``np`` is kept for signature
+    stability; the glob covers every rank any previous attempt had."""
+    del np  # the glob below is rank-set-agnostic on purpose
+    try:
+        names = os.listdir(heartbeat_dir)
+    except OSError:
+        return
+    for fn in names:
+        if fn.startswith("rank") and fn.endswith(".hb"):
+            try:
+                os.unlink(os.path.join(heartbeat_dir, fn))
+            except OSError:
+                pass
 
 
 def _collect(procs, drains, capture: bool):
@@ -743,7 +799,9 @@ def supervise(script: str, np: int = 2, args: list[str] | None = None,
               retry_all: bool = False,
               event_dir: str | None = None,
               quarantine_batches: bool = True,
-              max_skipped_batches: int | None = None) -> SuperviseResult:
+              max_skipped_batches: int | None = None,
+              elastic: bool | None = None,
+              min_np: int | None = None) -> SuperviseResult:
     """Budgeted checkpoint-restart supervision of a worker gang — the
     multi-process twin of ``XlaRunner.run_with_restarts`` (SURVEY.md §5.3).
 
@@ -793,6 +851,29 @@ def supervise(script: str, np: int = 2, args: list[str] | None = None,
     ``SPARKDL_MAX_SKIPPED_BATCHES``, 16) bounds the skip-list: past it a
     fatal :class:`~sparkdl_tpu.runner.failures.PoisonDataError` stops the
     supervisor from eating the dataset one batch at a time.
+
+    **Elastic gang supervision** (ISSUE 16, ``elastic=True`` or
+    ``SPARKDL_ELASTIC=1``): when the SAME rank dies in two *consecutive*
+    attempts at the same world size — the signature of a permanently lost
+    machine, since a transient preemption lands elsewhere (or nowhere) on
+    the relaunch — the gang **shrinks by one rank and relaunches without
+    consuming the restart budget** (losing a machine is the platform's
+    doing; the budget is for failures the supervisor can't act on),
+    bounded below by ``min_np`` (default ``SPARKDL_ELASTIC_MIN_NP``, 1).
+    Every later *budgeted* restart of a shrunken gang **re-probes the
+    original world size** (recovered capacity grows the gang back); a
+    probe that dies on a rank reverts to the working size as another free
+    relaunch. Each resize records a ``gang_resized`` degradation
+    (flight-recorder event + ``SuperviseResult.degradations``),
+    ``run_stats.resizes``, and the ``gang_resizes`` telemetry counter;
+    ``SuperviseResult.final_np`` reports the world size that finished.
+    ``SPARKDL_ELASTIC=1`` is propagated to the workers, whose
+    ``CheckpointManager.restore`` reshards the old-topology checkpoint
+    through a host template instead of refusing it — and a ``shard=True``
+    checkpointable dataset replays its cursor correctly at the new world
+    size because per-rank slices are cut from the GLOBAL stream at draw
+    time (see ``runner/data.py``). Fatal failures never shrink: a user
+    bug on 4 ranks is the same bug on 3.
     """
     if np < 1:
         raise ValueError(f"np must be >= 1, got {np}")
@@ -836,6 +917,23 @@ def supervise(script: str, np: int = 2, args: list[str] | None = None,
     extra_degradations: list[dict] = []  # supervisor-side (quarantines)
     prev_sig: tuple | None = None  # last failure's (step, batch_index)
 
+    # Elastic resize state (ISSUE 16). env= wins over the process
+    # environment, explicit kwargs win over both (same resolution order
+    # as every other supervisor knob).
+    elastic_on = failures.elastic_enabled(env) if elastic is None \
+        else bool(elastic)
+    if elastic_on:
+        # The workers must know: their checkpoint restore reshards a
+        # cross-topology manifest instead of refusing it.
+        env.setdefault(failures.ELASTIC_ENV, "1")
+    floor_np = failures.elastic_min_np(env) if min_np is None \
+        else max(1, int(min_np))
+    target_np = np        # the asked-for size; grow-back probe ceiling
+    cur_np = np           # the size the next attempt launches at
+    probe_from: int | None = None  # size to revert to if a probe fails
+    prev_dead: tuple | None = None  # last failure's (np, dead rank)
+    resizes = 0
+
     restarts = 0      # every relaunch, for the recovery ledger
     budget_used = 0   # failure-driven relaunches, checked against budget
     kinds: list[str] = []
@@ -846,12 +944,29 @@ def supervise(script: str, np: int = 2, args: list[str] | None = None,
     # The gang gets its own subdir (see _adopt_gang_metrics_dir); kept
     # on completion when non-empty, like gang event dirs.
     metrics_dir = adopted_metrics_dir = _adopt_gang_metrics_dir(env)
+
+    def _resize(to_np: int, reason: str, dead_rank: int | None = None,
+                probe: bool = False):
+        """World-size change bookkeeping: counters, flight-recorder event,
+        supervisor-side degradation record (same shape as the ranks'
+        collected events), and the new launch size."""
+        nonlocal cur_np, resizes
+        _record_resize(cur_np, to_np, rank=dead_rank)
+        events_lib.event("gang_resized", from_np=cur_np, to_np=to_np,
+                         reason=reason, dead_rank=dead_rank, probe=probe)
+        extra_degradations.append({
+            "t": round(time.time(), 6), "rank": None, "name": "gang_resized",
+            "from_np": cur_np, "to_np": to_np, "reason": reason,
+            "dead_rank": dead_rank})
+        resizes += 1
+        cur_np = to_np
+
     while True:
         # (_run_gang clears attempt N-1's heartbeats/traces before spawning)
         if metrics_dir:
             telemetry_lib.clear_rank_files(metrics_dir)
         status, results, info = _run_gang(
-            script, np, args, env, timeout_s, None, capture, poll_s,
+            script, cur_np, args, env, timeout_s, None, capture, poll_s,
             heartbeat_dir, watchdog_s, event_dir=event_dir)
         if status == "ok":
             # Survived-fault ledger BEFORE cleanup: a gang that recovered
@@ -883,10 +998,39 @@ def supervise(script: str, np: int = 2, args: list[str] | None = None,
                                    failure_kinds=kinds,
                                    degradations=degradations,
                                    quarantined_batches=list(quarantined),
-                                   metrics=gang_metrics)
+                                   metrics=gang_metrics,
+                                   resizes=resizes, final_np=cur_np)
         err = _failure(status, results, info, timeout_s, capture,
                        event_dir=event_dir, heartbeat_dir=heartbeat_dir,
                        metrics_dir=metrics_dir)
+        dead = _dead_rank_evidence(status, info, err) if elastic_on else None
+        if elastic_on and probe_from is not None:
+            # The attempt that just failed was a grow-back probe at the
+            # original world size.
+            was_probe_from, probe_from = probe_from, None
+            if dead is not None:
+                # The probed capacity is still gone (a rank died again).
+                # Reverting to the size that worked is a FREE relaunch:
+                # the probe answered its question, and burning budget on
+                # the answer would punish probing.
+                kinds.append("probe_failed")
+                restarts += 1
+                prev_dead = None
+                prev_sig = None
+                log.warning(
+                    "supervise: grow-back probe at world size %d failed "
+                    "(rank %d died); reverting to %d and relaunching "
+                    "(restart %d, budget untouched at %d/%d)",
+                    cur_np, dead, was_probe_from, restarts, budget_used,
+                    max_restarts)
+                _resize(was_probe_from, "grow_probe_failed",
+                        dead_rank=dead)
+                time.sleep(backoff_s)
+                continue
+            # Inconclusive probe (timeout / fatal / no rank attribution):
+            # revert to the working size and fall through to the normal
+            # budgeted policy for THIS failure.
+            _resize(was_probe_from, "grow_probe_inconclusive")
         sig = _batch_signature(err) if quarantine_batches else None
         # Correlate on the BATCH INDEX: the signature's step component is
         # reported but not compared — evidence sources disagree on it (a
@@ -943,6 +1087,7 @@ def supervise(script: str, np: int = 2, args: list[str] | None = None,
                     "first_failure", {}).get("error"),
                 "skip_list": list(skip_list)})
             prev_sig = None  # correlation window restarts fresh
+            prev_dead = None
             restarts += 1
             log.warning(
                 "supervise: two consecutive failures attributed to batch "
@@ -950,6 +1095,36 @@ def supervise(script: str, np: int = 2, args: list[str] | None = None,
                 "relaunching (restart %d, budget untouched at %d/%d)\n%s",
                 batch_index, step_, skip_list, restarts, budget_used,
                 max_restarts, str(err)[:600])
+            time.sleep(backoff_s)
+            continue
+        if elastic_on and dead is not None and prev_dead == (cur_np, dead):
+            # The SAME rank died in two consecutive attempts at the same
+            # world size: a permanently lost machine, not a transient
+            # flake (which lands elsewhere — or nowhere — on the
+            # relaunch). The poison-batch correlation, applied to ranks.
+            new_np = cur_np - 1
+            if new_np < floor_np:
+                err.args = (
+                    f"{err}\n(supervise: rank {dead} of {cur_np} is "
+                    f"permanently dead, but shrinking to {new_np} would "
+                    f"pass the elastic floor ({failures.ELASTIC_MIN_ENV}="
+                    f"{floor_np}); giving up after {budget_used} "
+                    f"restart(s) of budget {max_restarts}; "
+                    f"failure kinds: {kinds})",)
+                _prune_empty_gang_dir(adopted_dir)
+                _prune_empty_gang_dir(adopted_metrics_dir)
+                raise err
+            kinds.append("resized")
+            restarts += 1
+            prev_dead = None   # fresh correlation window at the new size
+            prev_sig = None
+            log.warning(
+                "supervise: rank %d died in two consecutive attempts at "
+                "world size %d — permanently dead; shrinking the gang to "
+                "%d and relaunching (restart %d, budget untouched at "
+                "%d/%d)\n%s", dead, cur_np, new_np, restarts, budget_used,
+                max_restarts, str(err)[:600])
+            _resize(new_np, "rank_dead", dead_rank=dead)
             time.sleep(backoff_s)
             continue
         kinds.append(err.kind)
@@ -964,6 +1139,7 @@ def supervise(script: str, np: int = 2, args: list[str] | None = None,
             # even right after an unrelated batch-attributed failure);
             # ever-changing fatal signatures stay bounded by the budget.
             prev_sig = sig
+            prev_dead = None  # fatal: no rank-death evidence this attempt
             restarts += 1
             budget_used += 1
             backoff = backoff_s * (2 ** (budget_used - 1))
@@ -979,6 +1155,9 @@ def supervise(script: str, np: int = 2, args: list[str] | None = None,
             # and must not read as a budget overrun in the postmortem.
             total = (f" ({restarts} relaunches total incl. quarantines)"
                      if restarts != budget_used else "")
+            if resizes:
+                total += (f"; {resizes} elastic resize(s), last world "
+                          f"size {cur_np}")
             err.args = (f"{err}\n(supervise: giving up after {budget_used} "
                         f"restart(s) of budget {max_restarts}{total}; "
                         f"failure kinds: {kinds})",)
@@ -989,9 +1168,21 @@ def supervise(script: str, np: int = 2, args: list[str] | None = None,
             _prune_empty_gang_dir(adopted_metrics_dir)
             raise err
         prev_sig = sig
+        prev_dead = (cur_np, dead) if dead is not None else None
         restarts += 1
         budget_used += 1
         backoff = backoff_s * (2 ** (budget_used - 1))
+        if elastic_on and cur_np < target_np:
+            # Re-probe the original world size on every budgeted restart:
+            # recovered capacity grows the gang back, and a probe that
+            # dies on a rank reverts FREE (above) — so probing costs
+            # nothing beyond the restart that was happening anyway.
+            probe_from = cur_np
+            prev_dead = None  # rank identities reshuffle at the new size
+            log.warning(
+                "supervise: probing recovered capacity — relaunching at "
+                "the original world size %d (was %d)", target_np, cur_np)
+            _resize(target_np, "grow_probe", probe=True)
         log.warning("supervise: gang attempt %d failed (%s); relaunching "
                     "in %.1fs (restart %d/%d)\n%s", restarts, err.kind,
                     backoff, budget_used, max_restarts, str(err)[:1000])

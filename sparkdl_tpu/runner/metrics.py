@@ -52,9 +52,20 @@ class RunStats:
     # Training data plane (ISSUE 5): poison batches the supervisor
     # quarantined onto the dataset skip-list.
     train_batches_quarantined: int = 0
+    # Elastic gang supervision (ISSUE 16): world-size changes the
+    # supervisor made around permanently dead ranks.
+    resizes: int = 0
+    last_resize: str | None = None
 
     def record_restart(self):
         self.restarts += 1
+
+    def record_resize(self, from_np: int, to_np: int,
+                      rank: int | None = None):
+        self.resizes += 1
+        self.last_resize = (f"np {from_np} -> {to_np}"
+                            + (f" (rank {rank} dead)"
+                               if rank is not None else ""))[:300]
 
     def record_failure(self, kind: str, detail: str | None = None):
         self.last_failure_kind = kind
@@ -92,7 +103,9 @@ class RunStats:
                 "dispatch_giveups": self.dispatch_giveups,
                 "checkpoint_rollbacks": self.checkpoint_rollbacks,
                 "last_rollback": self.last_rollback,
-                "train_batches_quarantined": self.train_batches_quarantined}
+                "train_batches_quarantined": self.train_batches_quarantined,
+                "resizes": self.resizes,
+                "last_resize": self.last_resize}
 
     def degraded(self) -> bool:
         """True when any fault-tolerance machinery actually engaged —
@@ -101,7 +114,7 @@ class RunStats:
         return bool(self.restarts or self.faults_injected
                     or self.rows_quarantined or self.dispatch_retries
                     or self.dispatch_giveups or self.checkpoint_rollbacks
-                    or self.train_batches_quarantined)
+                    or self.train_batches_quarantined or self.resizes)
 
     def reset(self):
         self.restarts = 0
@@ -115,6 +128,8 @@ class RunStats:
         self.checkpoint_rollbacks = 0
         self.last_rollback = None
         self.train_batches_quarantined = 0
+        self.resizes = 0
+        self.last_resize = None
 
 
 run_stats = RunStats()
@@ -361,7 +376,7 @@ def fault_tolerance_summary() -> dict | None:
             if k in ("restarts", "faults_injected", "rows_quarantined",
                      "dispatch_retries", "dispatch_giveups",
                      "checkpoint_rollbacks", "last_rollback",
-                     "train_batches_quarantined")
+                     "train_batches_quarantined", "resizes", "last_resize")
             and v}
 
 

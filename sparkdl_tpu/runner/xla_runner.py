@@ -273,7 +273,12 @@ class RunnerContext:
         start_step = 0
         if resume and self.checkpoints and \
                 self.checkpoints.latest_step() is not None:
-            state = self.checkpoints.restore(state)
+            # mesh = the CURRENT layout: restore's topology guard compares
+            # it against the manifest's save-time topology and — elastic
+            # (SPARKDL_ELASTIC=1) — reshards through a host template when
+            # the gang shrank/grew; the host leaves are replicated below
+            # by put_replicated exactly like a fresh start.
+            state = self.checkpoints.restore(state, mesh=self.mesh)
             start_step = int(state.step)
             cursor = None
             if dataset is not None and start_step > 0:

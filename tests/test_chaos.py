@@ -465,3 +465,56 @@ with open(os.path.join(out_dir, "attempts.jsonl"), "a") as f:
     # forensics: the corrupt step dir is quarantined on disk
     import glob as glob_mod
     assert glob_mod.glob(str(tmp_path / "ckpt" / "4.corrupt*"))
+
+
+class TestDecimateKind:
+    """ISSUE 16: `decimate` — a rank death whose SLOT stays dead. Unlike
+    every once=True kind (whose state_dir marker SUPPRESSES a re-fire so
+    the restarted gang can make progress), decimate's marker makes the
+    fault KEEP firing for the same rank at the same world size, modeling
+    permanently lost capacity. The kill path itself is subprocess-only
+    (SIGKILL of the calling process) — covered by the supervision tests
+    in test_multiprocess.py and scripts/elastic_smoke.py; here we pin
+    validation, env transport, and the marker semantics."""
+
+    def test_kind_validates_anywhere_and_roundtrips(self):
+        f = Fault("step_start", "decimate", at_step=5, rank=2)
+        back = FaultPlan.from_env(FaultPlan([f]).to_env())
+        assert back.faults == [f]
+        # any site: entry-point re-kill means site is just the first kill
+        assert Fault("worker", "decimate", prob=1.0).kind == "decimate"
+        with pytest.raises(ValueError, match="kind"):
+            Fault("step_start", "decimated", at_step=1)
+
+    def test_marker_is_rank_and_world_scoped(self, tmp_path, monkeypatch):
+        """The marker names (rank, world): after the supervisor shrinks,
+        the new gang's rank 2 is a DIFFERENT slot and must not inherit
+        the old world's death."""
+        plan = FaultPlan([Fault("step_start", "decimate", at_step=5,
+                                rank=2)], state_dir=str(tmp_path))
+        monkeypatch.setenv("SPARKDL_PROCESS_ID", "2")
+        monkeypatch.setenv("SPARKDL_NUM_PROCESSES", "4")
+        marker = plan.decimate_marker(2)
+        assert marker.endswith("chaos_decimated_rank2_np4")
+        assert not plan._slot_decimated()
+        plan._mark_decimated()
+        assert plan._slot_decimated()
+        # same rank id, shrunken world: alive
+        monkeypatch.setenv("SPARKDL_NUM_PROCESSES", "3")
+        assert not plan._slot_decimated()
+        # other ranks of the original world: alive
+        monkeypatch.setenv("SPARKDL_NUM_PROCESSES", "4")
+        monkeypatch.setenv("SPARKDL_PROCESS_ID", "1")
+        assert not plan._slot_decimated()
+
+    def test_no_state_dir_degrades_to_plain_sigkill(self, monkeypatch):
+        """Without a state_dir there is nowhere to persist the dead slot:
+        decimate degrades to a one-shot sigkill (documented), and the
+        re-kill probe reports 'not decimated' instead of crashing."""
+        plan = FaultPlan([Fault("step_start", "decimate", at_step=5,
+                                rank=2)])
+        monkeypatch.setenv("SPARKDL_PROCESS_ID", "2")
+        assert plan.decimate_marker(2) is None
+        assert not plan._slot_decimated()
+        plan._mark_decimated()  # no-op, must not raise
+        assert not plan._slot_decimated()

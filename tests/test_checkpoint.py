@@ -257,3 +257,165 @@ class TestReviewRegressions:
         assert not glob.glob(d + "/*.corrupt*")
         assert m.verify_step(2) == (True, "ok")
         m.close()
+
+
+class TestElasticReshard:
+    """ISSUE 16: manifests fingerprint the save-time topology; restoring
+    into a different mesh either refuses with a clear topology error
+    (default) or — under SPARKDL_ELASTIC=1 — re-lays-out every leaf over
+    the new mesh through divisible_rules, bit-identical. conftest forces
+    8 virtual CPU devices, so meshes of 4/2/1 model the world sizes a
+    shrinking gang passes through."""
+
+    @staticmethod
+    def _mesh(n, axis="data"):
+        from jax.sharding import Mesh
+        return Mesh(np.array(jax.devices()[:n]), (axis,))
+
+    @staticmethod
+    def _tree(value=None, seed=0):
+        """8x4 kernel (divides at 4/2/1), 4-dim bias (1-D, replicated),
+        6x4 table (6 splits at 2/1 but NOT 4 — exercises the
+        divisible-fallback on both the save and restore layouts)."""
+        rng = np.random.RandomState(seed)
+
+        def leaf(*shape):
+            if value is not None:
+                return np.full(shape, value, np.float32)
+            return rng.randn(*shape).astype(np.float32)
+
+        return {"dense": {"kernel": leaf(8, 4), "bias": leaf(4)},
+                "table": {"kernel": leaf(6, 4)}}
+
+    def _save_fsdp(self, d, n_dev, step=3):
+        from sparkdl_tpu.parallel.sharding import (divisible_rules,
+                                                   fsdp_rules, shard_params)
+        mesh = self._mesh(n_dev)
+        rules = fsdp_rules(mesh=mesh)
+        state = TrainState.create(None, self._tree(), optax.sgd(0.1))
+        sharded = shard_params(state, mesh, divisible_rules(rules, mesh))
+        m = CheckpointManager(d, async_save=False)
+        m.save(step, sharded, wait=True)
+        m.close()
+        return jax.tree_util.tree_map(np.asarray, sharded.params)
+
+    def test_fsdp_shrink_roundtrip_bit_identical(self, tmp_path,
+                                                 monkeypatch):
+        """Save at world 4, restore at 2 and at 1: every param leaf equals
+        the original bit-for-bit and lives on the NEW mesh."""
+        from sparkdl_tpu.parallel.sharding import fsdp_rules
+        d = str(tmp_path / "ckpt")
+        originals = self._save_fsdp(d, 4)
+        monkeypatch.setenv("SPARKDL_ELASTIC", "1")
+        for n in (2, 1):
+            mesh = self._mesh(n)
+            template = TrainState.create(None, self._tree(value=0.0),
+                                         optax.sgd(0.1))
+            m = CheckpointManager(d)
+            restored = m.restore(template, mesh=mesh,
+                                 rules=fsdp_rules(mesh=mesh))
+            m.close()
+            jax.tree_util.tree_map(
+                lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                           b),
+                restored.params, originals)
+            k = restored.params["dense"]["kernel"]
+            assert dict(k.sharding.mesh.shape) == {"data": n}
+            assert int(restored.step) == 0  # template's fresh step layout
+
+    def test_fsdp_grow_roundtrip_bit_identical(self, tmp_path, monkeypatch):
+        """The grow-back direction: saved by the SHRUNKEN gang (world 2),
+        restored by the recovered one (world 4)."""
+        from sparkdl_tpu.parallel.sharding import fsdp_rules
+        d = str(tmp_path / "ckpt")
+        originals = self._save_fsdp(d, 2)
+        monkeypatch.setenv("SPARKDL_ELASTIC", "1")
+        mesh4 = self._mesh(4)
+        template = TrainState.create(None, self._tree(value=0.0),
+                                     optax.sgd(0.1))
+        m = CheckpointManager(d)
+        restored = m.restore(template, mesh=mesh4,
+                             rules=fsdp_rules(mesh=mesh4))
+        m.close()
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a), b),
+            restored.params, originals)
+        assert dict(restored.params["dense"]["kernel"]
+                    .sharding.mesh.shape) == {"data": 4}
+
+    def test_serving_tp_layout_reshard_roundtrip(self, tmp_path,
+                                                 monkeypatch):
+        """The serving rule set reshards too: a tp=4 engine checkpoint
+        restores onto a tp=2 mesh with identical weights."""
+        from sparkdl_tpu.parallel.sharding import (divisible_rules,
+                                                   serving_tp_layout,
+                                                   shard_params)
+        rng = np.random.RandomState(7)
+        params = {p: {"kernel": rng.randn(8, 8).astype(np.float32)}
+                  for p in ("q_proj", "o_proj", "up_proj", "down_proj")}
+        mesh4 = self._mesh(4, axis="tp")
+        layout4 = serving_tp_layout(4)
+        sharded = shard_params(params, mesh4,
+                               divisible_rules(layout4.rules, mesh4))
+        d = str(tmp_path / "ckpt")
+        m = CheckpointManager(d, async_save=False)
+        state = TrainState.create(None, sharded, optax.sgd(0.1))
+        m.save(1, state, wait=True)
+        m.close()
+        originals = jax.tree_util.tree_map(np.asarray, sharded)
+
+        monkeypatch.setenv("SPARKDL_ELASTIC", "1")
+        mesh2 = self._mesh(2, axis="tp")
+        template = TrainState.create(
+            None, jax.tree_util.tree_map(np.zeros_like, originals),
+            optax.sgd(0.1))
+        m2 = CheckpointManager(d)
+        restored = m2.restore(template, mesh=mesh2,
+                              rules=serving_tp_layout(2).rules)
+        m2.close()
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a), b),
+            restored.params, originals)
+        q = restored.params["q_proj"]["kernel"]
+        assert dict(q.sharding.mesh.shape) == {"tp": 2}
+
+    def test_mismatch_without_elastic_raises_topology_error(
+            self, tmp_path, monkeypatch):
+        """The default (SPARKDL_ELASTIC unset) must fail loudly at the
+        TOPOLOGY layer — naming both layouts and the env knob — not leak
+        a device_put shape error from orbax."""
+        from sparkdl_tpu.parallel.sharding import fsdp_rules
+        from sparkdl_tpu.runner.checkpoint import CheckpointTopologyError
+        d = str(tmp_path / "ckpt")
+        self._save_fsdp(d, 4)
+        monkeypatch.delenv("SPARKDL_ELASTIC", raising=False)
+        mesh2 = self._mesh(2)
+        template = TrainState.create(None, self._tree(value=0.0),
+                                     optax.sgd(0.1))
+        m = CheckpointManager(d)
+        with pytest.raises(CheckpointTopologyError) as ei:
+            m.restore(template, step=3, mesh=mesh2,
+                      rules=fsdp_rules(mesh=mesh2))
+        m.close()
+        msg = str(ei.value)
+        assert "topology mismatch" in msg
+        assert "'data': 4" in msg and "'data': 2" in msg
+        assert "SPARKDL_ELASTIC" in msg
+
+    def test_same_topology_restore_unaffected(self, tmp_path, monkeypatch):
+        """No mismatch -> the pre-ISSUE-16 path exactly: no elastic env
+        needed, no reshard event, works with mesh passed or not."""
+        from sparkdl_tpu.parallel.sharding import fsdp_rules
+        d = str(tmp_path / "ckpt")
+        originals = self._save_fsdp(d, 4)
+        monkeypatch.delenv("SPARKDL_ELASTIC", raising=False)
+        mesh4 = self._mesh(4)
+        template = TrainState.create(None, self._tree(value=0.0),
+                                     optax.sgd(0.1))
+        m = CheckpointManager(d)
+        restored = m.restore(template, mesh=mesh4,
+                             rules=fsdp_rules(mesh=mesh4))
+        m.close()
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a), b),
+            restored.params, originals)
