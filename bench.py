@@ -1477,6 +1477,14 @@ def main():
             st = global_step_stats.summary()
             if isinstance(result, dict) and st:
                 result.setdefault("step_time", st)
+            # Anomaly-sentinel verdicts (ISSUE 17): per-metric counts of
+            # rolling-p95 drift events the worker's sentinel fired —
+            # only when it fired, same no-noise rule as run_stats.
+            from sparkdl_tpu.runner import sentinel
+            an = sentinel.anomaly_counts()
+            if isinstance(result, dict) and an:
+                result.setdefault("failure_stats",
+                                  {})["sentinel_anomalies"] = an
         except Exception:
             pass
         print(json.dumps(result))
@@ -1681,6 +1689,7 @@ def main():
     # run_with_restarts), so the record shows HOW the number was survived.
     fs = {"restarts": budget.restarts, "faults_injected": 0,
           "last_failure_kind": budget.last_failure_kind}
+    sentinel_counts: dict = {}
     for r in (train, feat, flash, bert, gen, serve, ns):
         ws = (r or {}).get("failure_stats") if isinstance(r, dict) else None
         if isinstance(ws, dict):
@@ -1688,6 +1697,12 @@ def main():
             fs["faults_injected"] += int(ws.get("faults_injected") or 0)
             fs["last_failure_kind"] = (ws.get("last_failure_kind")
                                        or fs["last_failure_kind"])
+            # Sentinel anomaly counts (ISSUE 17): summed per metric
+            # across the worker legs that fired any.
+            for k, v in (ws.get("sentinel_anomalies") or {}).items():
+                sentinel_counts[k] = sentinel_counts.get(k, 0) + int(v)
+    if sentinel_counts:
+        fs["sentinel_anomalies"] = sentinel_counts
     # Elastic gang supervision (ISSUE 16): resizes / final world size /
     # exactly-once verdict from the jax-free policy leg.
     fs["elastic"] = _elastic_block(budget)

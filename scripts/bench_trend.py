@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""Machine-check the BENCH_r*.json trajectory (ISSUE 17 satellite).
+
+Each growth round appends a ``BENCH_r{N}.json`` record; nothing so far
+*reads* the sequence, so a regression only surfaces when a human eyeballs
+two files. This script loads every round, extracts per-metric series
+(the headline ``parsed.value`` keyed by ``parsed.metric``, plus every
+numeric scalar in ``parsed.extra`` — mfu, step_time_s, serve_tokens_s,
+stall ratios, ...), prints a trend table, and **exits nonzero when the
+newest valid value regressed past ``--threshold`` versus the best prior
+valid value**.
+
+Rounds where the harness never reached a measurement — ``parsed`` null
+(rc 124 timeouts) or ``parsed.error`` set (``backend_unavailable``
+probes) — are *excluded from regression endpoints* and annotated in the
+table instead: a CPU-only container scoring 0.0 img/s must read as "no
+evidence", not as a 100% regression.
+
+Direction is inferred from the metric name: stall/latency/ttft/
+step-time-shaped names are lower-is-better; everything else (throughput,
+mfu, hit rates, speedups) higher-is-better.
+
+Usage:
+    python scripts/bench_trend.py [--dir REPO] [--threshold 0.15]
+        [--json]
+
+Exit codes: 0 = no regression; 1 = regression past threshold;
+2 = fewer than two valid rounds (no trend to check).
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+# Metric-name shapes where smaller numbers are better. Everything else
+# is treated as higher-is-better (throughput, mfu, hit rate, speedup).
+_LOWER_IS_BETTER = re.compile(
+    r"(stall|latency|ttft|step_time|_time_s$|_s$|_ratio$|skew)", re.I)
+# extra[] keys that are config/identity, not measurements.
+_NON_METRIC_EXTRA = ("n_chips", "batch_per_chip", "steps", "image_size",
+                     "seq_len", "budget")
+
+
+def _valid(parsed) -> bool:
+    """A round counts as measurement evidence only when the harness
+    actually measured: parsed present and no probe error recorded."""
+    return isinstance(parsed, dict) and not parsed.get("error")
+
+
+def _series(records: list[dict]) -> dict:
+    """``{metric_name: [(round_n, value), ...]}`` over valid rounds."""
+    out: dict = {}
+    for rec in records:
+        parsed = rec.get("parsed")
+        if not _valid(parsed):
+            continue
+        n = rec.get("n")
+        vals = {}
+        if isinstance(parsed.get("value"), (int, float)) \
+                and parsed.get("metric"):
+            vals[str(parsed["metric"])] = float(parsed["value"])
+        for k, v in (parsed.get("extra") or {}).items():
+            if k in _NON_METRIC_EXTRA:
+                continue
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                vals[k] = float(v)
+        for k, v in vals.items():
+            out.setdefault(k, []).append((n, v))
+    return out
+
+
+def trend(records: list[dict], threshold: float = 0.15) -> dict:
+    """Pure trend computation (the synthetic test drives this directly).
+
+    For each metric with >= 2 valid points: compare the LATEST valid
+    value against the BEST prior valid value (min for lower-is-better
+    names, max otherwise). ``change`` > 0 means worse. A metric regresses
+    when change > threshold.
+    """
+    rounds = sorted(records, key=lambda r: r.get("n", 0))
+    skipped = [{"n": r.get("n"),
+                "reason": "no parse" if not isinstance(r.get("parsed"),
+                                                       dict)
+                else str((r["parsed"].get("error") or {}).get(
+                    "kind", "error"))}
+               for r in rounds if not _valid(r.get("parsed"))]
+    metrics = []
+    for name, pts in sorted(_series(rounds).items()):
+        lower = bool(_LOWER_IS_BETTER.search(name))
+        last_n, last = pts[-1]
+        entry = {"metric": name, "direction":
+                 "lower" if lower else "higher",
+                 "points": len(pts), "latest_round": last_n,
+                 "latest": last}
+        if len(pts) < 2:
+            entry["change"] = None
+        else:
+            prior = [v for _, v in pts[:-1]]
+            best = min(prior) if lower else max(prior)
+            entry["best_prior"] = best
+            if best == 0.0:
+                # can't express relative change off a zero baseline
+                entry["change"] = None
+            else:
+                chg = (last - best) / abs(best)
+                entry["change"] = round(chg if lower else -chg, 4)
+        entry["regressed"] = bool(entry["change"] is not None
+                                  and entry["change"] > threshold)
+        metrics.append(entry)
+    regressions = [m for m in metrics if m["regressed"]]
+    return {"rounds": len(rounds), "valid_rounds":
+            len(rounds) - len(skipped), "skipped": skipped,
+            "threshold": threshold, "metrics": metrics,
+            "regressions": [m["metric"] for m in regressions],
+            "ok": not regressions}
+
+
+def load_records(repo_dir: str) -> list[dict]:
+    recs = []
+    for path in sorted(glob.glob(os.path.join(repo_dir,
+                                              "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                recs.append(json.load(f))
+        except (OSError, ValueError):
+            continue
+    return recs
+
+
+def _table(rep: dict) -> str:
+    lines = [f"bench_trend: {rep['valid_rounds']}/{rep['rounds']} "
+             f"rounds measured, threshold "
+             f"{rep['threshold'] * 100:.0f}%"]
+    for s in rep["skipped"]:
+        lines.append(f"  r{s['n']:02d}: skipped ({s['reason']})")
+    w = max((len(m["metric"]) for m in rep["metrics"]), default=6)
+    for m in rep["metrics"]:
+        chg = ("    --" if m["change"] is None
+               else f"{m['change'] * +100:+6.1f}%")
+        flag = "  << REGRESSED" if m["regressed"] else ""
+        lines.append(f"  {m['metric']:<{w}}  ({m['direction'][0]}) "
+                     f"n={m['points']:<2d} latest={m['latest']:<12.6g} "
+                     f"worse-by={chg}{flag}")
+    lines.append("bench_trend: " + ("OK" if rep["ok"] else
+                                    f"REGRESSION: "
+                                    f"{', '.join(rep['regressions'])}"))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Trend table + regression gate over BENCH_r*.json")
+    ap.add_argument("--dir", default=os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))),
+        help="repo dir holding BENCH_r*.json (default: repo root)")
+    ap.add_argument("--threshold", type=float, default=0.15,
+                    help="relative worsening that fails the gate "
+                         "(default 0.15 = 15%%)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable report instead of "
+                         "the table")
+    ns = ap.parse_args(argv)
+
+    recs = load_records(ns.dir)
+    rep = trend(recs, threshold=ns.threshold)
+    if ns.json:
+        print(json.dumps(rep, default=str))
+    else:
+        print(_table(rep))
+    if rep["valid_rounds"] < 2:
+        print("bench_trend: fewer than two measured rounds — no trend "
+              "to check", file=sys.stderr)
+        return 2
+    return 0 if rep["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,6 +24,13 @@ fused feed ships zero-copy uint8 views and the compiled program does
 flip/cast/resize, so decode time collapses and attribution moves to the
 device stages.
 
+Leg 3 (causal trace, ISSUE 17): a supervised 2-rank gang runs under a
+supervisor-minted trace id, then a stub-backend engine serves requests
+under the same trace; ``scripts/trace_export.py --validate`` must merge
+both ranks' streams, the serving request spans, and telemetry gauge
+histories into one Chrome trace where every span carries the run's
+trace_id with a parent chain resolving to the run root.
+
 Prints one JSON line; exits 0 iff all legs held.
 
 Run: ``JAX_PLATFORMS=cpu python scripts/obs_smoke.py``
@@ -349,6 +356,103 @@ def _serving_leg(out_dir: str) -> dict:
             os.environ.pop(v, None)
 
 
+_TRACE_WORKER = """
+import os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, {repo!r})
+from sparkdl_tpu.runner import events, metrics
+
+for i in range(3):
+    with events.span("train_step", step=i):
+        time.sleep(0.01)
+    metrics.touch_heartbeat(i)
+events.reset()  # close the stream cleanly
+"""
+
+
+def _trace_leg(out_dir: str) -> dict:
+    """ISSUE 17: causal trace, end-to-end. A supervised 2-rank gang runs
+    under a supervisor-minted trace id; afterwards a stub-backend engine
+    serves requests IN THIS PROCESS under the same trace (env-adopted
+    parent = the run root). ``trace_export.py`` must then merge both
+    ranks' streams + the serving spans + telemetry gauge histories into
+    one valid Chrome trace: every span carries the one trace_id and a
+    parent chain resolving to the run root, >= 2 rank pids, >= 1 request
+    track, counter tracks present, clock skew annotated."""
+    import subprocess
+    import time
+
+    event_dir = os.path.join(out_dir, "trace_events")
+    hb_dir = os.path.join(out_dir, "trace_hb")
+    metrics_dir = os.path.join(out_dir, "trace_metrics")
+    worker = os.path.join(out_dir, "trace_worker.py")
+    with open(worker, "w") as f:
+        f.write(_TRACE_WORKER.format(repo=_REPO))
+
+    supervise(worker, np=2, timeout_s=300.0, max_restarts=0,
+              backoff_s=0.1, poll_s=0.25, event_dir=event_dir,
+              heartbeat_dir=hb_dir)
+
+    from sparkdl_tpu.runner import events, telemetry, traceview
+    manifest = traceview.find_trace_manifest(event_dir) or {}
+    os.environ["SPARKDL_EVENT_DIR"] = event_dir
+    os.environ[events.TRACE_ID_ENV] = manifest.get("trace_id") or ""
+    os.environ[events.TRACE_PARENT_ENV] = \
+        manifest.get("root_span_id") or ""
+    os.environ["SPARKDL_METRICS_INTERVAL_S"] = "0.05"
+    try:
+        from sparkdl_tpu.serving import GenerationEngine, StubBackend
+
+        events.reset()  # re-arm on the gang's dir, now traced
+        telemetry.reset()
+        telemetry.start(metrics_dir=metrics_dir)
+        eng = GenerationEngine(StubBackend(2, 128, step_s=0.002),
+                               prefill_chunk=8)
+        for i in range(3):
+            eng.submit([1 + i, 2, 3], max_new_tokens=8)
+        eng.run_until_idle()
+        time.sleep(0.12)  # one exporter tick -> a history line on disk
+        telemetry.stop()
+        telemetry.reset()
+        events.reset()  # close the stream so the export reads full books
+    finally:
+        for v in ("SPARKDL_EVENT_DIR", events.TRACE_ID_ENV,
+                  events.TRACE_PARENT_ENV, "SPARKDL_METRICS_INTERVAL_S"):
+            os.environ.pop(v, None)
+
+    summary = {}
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(_REPO, "scripts", "trace_export.py"), event_dir,
+         "--metrics-dir", metrics_dir, "--heartbeat-dir", hb_dir,
+         "--validate", "--require-ranks", "2", "--require-requests",
+         "1", "--require-counters"],
+        capture_output=True, text=True, timeout=120)
+    for line in reversed(proc.stdout.strip().splitlines()):
+        if line.startswith("{"):
+            summary = json.loads(line)
+            break
+    verdict = summary.get("validation") or {}
+    skew = summary.get("clock_skew") or {}
+    return {
+        "export_rc": proc.returncode,
+        "trace_id": summary.get("trace_id"),
+        "spans": summary.get("spans"),
+        "requests": summary.get("requests"),
+        "ranks": verdict.get("ranks"),
+        "traced_spans": verdict.get("traced_spans"),
+        "counters": verdict.get("counters"),
+        "skew_measured": skew.get("measured"),
+        "problems": verdict.get("problems"),
+        "ok": proc.returncode == 0
+        and verdict.get("ok") is True
+        and summary.get("trace_id") == manifest.get("trace_id")
+        and bool(manifest.get("trace_id"))
+        and (verdict.get("traced_spans") or 0) > 0
+        and skew.get("measured") is True,
+    }
+
+
 def main() -> int:
     out_dir = tempfile.mkdtemp(prefix="sparkdl-obs-smoke-")
     event_dir = os.path.join(out_dir, "events")
@@ -383,8 +487,9 @@ def main() -> int:
     telemetry = _scoring_leg(out_dir)
     ingest = _ingest_leg(out_dir)
     serving = _serving_leg(out_dir)
+    trace = _trace_leg(out_dir)
     ok = postmortem_ok and telemetry["ok"] and ingest["ok"] \
-        and serving["ok"]
+        and serving["ok"] and trace["ok"]
     print(json.dumps({
         "ok": ok,
         "postmortem_ok": postmortem_ok,
@@ -397,6 +502,7 @@ def main() -> int:
         "telemetry": telemetry,
         "ingest": ingest,
         "serving": serving,
+        "trace": trace,
         "out_dir": out_dir,
     }))
     return 0 if ok else 1

@@ -42,7 +42,7 @@ __all__ = ["intervals_from_events", "read_span_stream", "load_event_dir",
 _EVENT_FILE_RE = re.compile(r"events_rank(\d+)\.jsonl$")
 # Span names that are not pipeline *stages*: whole-run envelopes whose
 # duration would swamp every real stage's busy fraction.
-_NON_STAGE_SPANS = frozenset({"eval"})
+_NON_STAGE_SPANS = frozenset({"eval", "serve_request"})
 
 
 def read_span_stream(path: str) -> list[dict]:

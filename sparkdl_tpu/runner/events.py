@@ -31,24 +31,36 @@ primitive in the codebase.
 from __future__ import annotations
 
 import collections
+import itertools
 import json
 import logging
 import os
 import re
 import threading
 import time
+import uuid
 
 __all__ = ["FlightRecorder", "Timer", "RECORDER_DIR_ENV", "RING_ENV",
+           "TRACE_ID_ENV", "TRACE_PARENT_ENV",
            "event", "span", "postmortem", "get_recorder", "reset",
            "enable_flight_recorder", "merge_timeline", "format_timeline",
            "write_gang_postmortem", "clear_rank_files",
-           "collect_degradations", "add_tee", "remove_tee"]
+           "collect_degradations", "add_tee", "remove_tee",
+           "trace_armed", "new_trace_id", "new_span_id", "current_span_id"]
 
 log = logging.getLogger("sparkdl_tpu.runner")
 
 RECORDER_DIR_ENV = "SPARKDL_EVENT_DIR"
 RING_ENV = "SPARKDL_EVENT_RING"
 STREAM_CAP_ENV = "SPARKDL_EVENT_MAX_MB"
+# Causal trace context (ISSUE 17): the driver mints one run-level trace id
+# and ships it to every rank; each gang attempt/resize gets a parent span
+# id so a rank's whole stream chains under the supervise() attempt that
+# launched it. Both ride the environment — the same channel coordinator
+# address and rank already use — so a rank inherits its causal position
+# with zero protocol.
+TRACE_ID_ENV = "SPARKDL_TRACE_ID"
+TRACE_PARENT_ENV = "SPARKDL_TRACE_PARENT"
 _DEFAULT_RING = 512
 _DEFAULT_STREAM_CAP_MB = 256  # per-rank JSONL cap; ring keeps recording
 _POSTMORTEM_TAIL = 128  # events carried in a crash postmortem
@@ -84,6 +96,69 @@ def remove_tee(cb) -> None:
         pass
 
 
+# -- trace context (ISSUE 17) -------------------------------------------------
+# Spans gain span_id/parent_id from a thread-local span stack, so nested
+# regions chain causally WITHIN a thread and a feed thread's spans never
+# parent under the training loop's. The machinery is armed only when
+# SPARKDL_TRACE_ID is set: untraced runs keep emitting byte-identical
+# records (one env lookup per span, the same cost class as emit's
+# existing RECORDER_DIR_ENV read).
+
+_TRACE_TLS = threading.local()
+_SPAN_SEQ = itertools.count(1)
+
+
+def trace_armed() -> bool:
+    """True when a run-level trace id is in the environment."""
+    return bool(os.environ.get(TRACE_ID_ENV))
+
+
+def new_trace_id() -> str:
+    """Mint a run-level trace id (driver side, once per supervise/launch)."""
+    return uuid.uuid4().hex[:16]
+
+
+def new_span_id() -> str:
+    """Cheap process-unique span id: rank + pid + per-process counter.
+    No randomness on the hot path — uniqueness comes from the (pid, seq)
+    pair, and the rank prefix makes raw streams greppable by origin."""
+    return f"{_rank()}-{os.getpid():x}-{next(_SPAN_SEQ):x}"
+
+
+def current_span_id() -> str | None:
+    """Innermost open span on THIS thread, else the env-shipped parent
+    (the supervise() attempt span that launched this process), else None.
+    The fallback is what chains a rank's outermost spans — and a bare
+    point event emitted outside any span — to the driver's attempt."""
+    st = getattr(_TRACE_TLS, "stack", None)
+    if st:
+        return st[-1]
+    return os.environ.get(TRACE_PARENT_ENV) or None
+
+
+def _push_span(span_id: str) -> None:
+    st = getattr(_TRACE_TLS, "stack", None)
+    if st is None:
+        st = _TRACE_TLS.stack = []
+    st.append(span_id)
+
+
+def _pop_span(span_id: str) -> None:
+    st = getattr(_TRACE_TLS, "stack", None)
+    if not st:
+        return
+    if st[-1] == span_id:
+        st.pop()
+    else:
+        # A span exited out of order (generator-held context manager, or
+        # exit on a different thread than enter): drop just that id —
+        # corrupting the WHOLE stack would mis-parent every later span.
+        try:
+            st.remove(span_id)
+        except ValueError:
+            pass
+
+
 class Timer:
     """``with Timer() as t: ...`` then ``t.seconds`` — blocks on ``block_on``
     (a jax pytree) before stopping, so device work is actually counted.
@@ -113,7 +188,7 @@ class _Span(Timer):
     """Begin/end event pair around a region; duration and (on failure) the
     exception ride the end event."""
 
-    __slots__ = ("_rec", "_name", "_attrs")
+    __slots__ = ("_rec", "_name", "_attrs", "_span_id")
 
     def __init__(self, rec: "FlightRecorder", name: str, block_on=None,
                  **attrs):
@@ -121,9 +196,21 @@ class _Span(Timer):
         self._rec = rec
         self._name = name
         self._attrs = attrs
+        self._span_id = None
 
     def __enter__(self):
         super().__enter__()
+        if trace_armed():
+            # span_id/parent_id land in _attrs so BOTH the B and the E
+            # record carry them; an explicit span_id/parent_id kwarg
+            # (the serving engine parenting under a request's admission
+            # span) wins over the ambient stack.
+            self._span_id = self._attrs.get("span_id") or new_span_id()
+            parent = self._attrs.get("parent_id") or current_span_id()
+            if parent is not None:
+                self._attrs.setdefault("parent_id", parent)
+            self._attrs["span_id"] = self._span_id
+            _push_span(self._span_id)
         self._rec.emit(self._name, "B", self._attrs)
         return self
 
@@ -135,6 +222,11 @@ class _Span(Timer):
         return self
 
     def __exit__(self, exc_type, exc, tb):
+        if self._span_id is not None:
+            # Pop before the end event: anything emitted from here on
+            # (including the E record itself, which carries explicit ids)
+            # belongs to the enclosing scope, not the closed region.
+            _pop_span(self._span_id)
         block_err = None
         try:
             super().__exit__(exc_type, exc, tb)
@@ -215,6 +307,16 @@ class FlightRecorder:
                "name": name, "ph": ph, "rank": _rank()}
         if attrs:
             rec.update(attrs)
+        tid = os.environ.get(TRACE_ID_ENV)
+        if tid:
+            rec.setdefault("trace_id", tid)
+            if "span_id" not in rec and "parent_id" not in rec:
+                # Bare point events (chaos fires, anomaly, slo_breach)
+                # parent under the innermost open span — or the
+                # env-shipped attempt span when emitted outside any.
+                parent = current_span_id()
+                if parent is not None:
+                    rec["parent_id"] = parent
         self.ring.append(rec)
         if _TEES:
             for cb in _TEES:
@@ -243,6 +345,11 @@ class FlightRecorder:
         sequentially can overlap-union slightly high, which `analysis`
         clamps."""
         t1 = time.time()
+        if trace_armed():
+            attrs.setdefault("span_id", new_span_id())
+            parent = current_span_id()
+            if parent is not None:
+                attrs.setdefault("parent_id", parent)
         self.emit(name, "B", attrs, t=t1 - max(0.0, dur_s))
         end = dict(attrs)
         end["dur_s"] = round(max(0.0, dur_s), 6)
@@ -418,6 +525,13 @@ def enable_flight_recorder(event_dir: str | None = None,
 _EVENT_FILE_RE = re.compile(r"events_rank(\d+)\.jsonl$")
 _POSTMORTEM_FILE_RE = re.compile(r"postmortem_rank(\d+)\.json$")
 GANG_TIMELINE_FILE = "gang_timeline.json"
+# Supervisor-side span tree (ISSUE 17): trace id, run-root span, and one
+# entry per gang attempt/resize. Lives NEXT TO the per-rank streams but is
+# NOT cleared per attempt (clear_rank_files deletes by the rank-file
+# patterns only) — the manifest is how trace_export resolves a rank
+# stream's env-shipped parent chain back to the run root after earlier
+# attempts' streams have been cleared.
+TRACE_MANIFEST_FILE = "trace_manifest.json"
 _MERGE_TAIL_BYTES = 1 << 20  # per-rank read cap when merging timelines
 # Survived-fault narrative (ISSUE 4/5): engaged-and-recovered machinery.
 # `give_up` is NOT here — an exhausted retry budget is failure evidence.

@@ -599,6 +599,14 @@ def _prune_empty_gang_dir(adopted_dir: str | None):
     if not adopted_dir:
         return
     try:
+        # The supervisor's own trace manifest doesn't count as worker
+        # telemetry: a gang whose ranks wrote no traces still prunes.
+        if os.listdir(adopted_dir) == [events_lib.TRACE_MANIFEST_FILE]:
+            os.unlink(os.path.join(adopted_dir,
+                                   events_lib.TRACE_MANIFEST_FILE))
+    except OSError:
+        pass
+    try:
         os.rmdir(adopted_dir)  # only succeeds when empty — exactly right
     except OSError:
         pass
@@ -771,6 +779,25 @@ def launch(script: str, np: int = 2, args: list[str] | None = None,
     # become THIS gang's failure evidence.
     env = dict(env or {})
     metrics_dir = adopted_metrics_dir = _adopt_gang_metrics_dir(env)
+    # Trace context (ISSUE 17): single-attempt twin of supervise()'s
+    # per-attempt spans — every rank chains under one launch-root span.
+    trace_id = env.get(events_lib.TRACE_ID_ENV) \
+        or os.environ.get(events_lib.TRACE_ID_ENV) \
+        or events_lib.new_trace_id()
+    env[events_lib.TRACE_ID_ENV] = trace_id
+    trace_root = events_lib.new_span_id()
+    env[events_lib.TRACE_PARENT_ENV] = trace_root
+    if event_dir:
+        try:
+            events_lib.atomic_write_json(
+                os.path.join(event_dir, events_lib.TRACE_MANIFEST_FILE),
+                {"trace_id": trace_id, "root_span_id": trace_root,
+                 "spans": [{"span_id": trace_root, "parent_id": None,
+                            "name": "launch", "t": round(time.time(), 6),
+                            "np": np,
+                            "script": os.path.basename(script)}]})
+        except OSError:
+            pass
     status, results, info = _run_gang(
         script, np, args, env, timeout_s, coordinator, capture, poll_s,
         heartbeat_dir, watchdog_s, event_dir=event_dir)
@@ -904,6 +931,41 @@ def supervise(script: str, np: int = 2, args: list[str] | None = None,
     os.makedirs(event_dir, exist_ok=True)
     env["SPARKDL_EVENT_DIR"] = event_dir
 
+    # Causal trace root (ISSUE 17): ONE run-level trace id for the whole
+    # supervised run (a caller/driver-minted id wins — supervise may be a
+    # child of a larger traced pipeline); each attempt mints a fresh span
+    # under the run root and ships it as SPARKDL_TRACE_PARENT, so every
+    # rank-side span chains to the attempt that launched it. The manifest
+    # is the supervisor's half of the tree — trace_export resolves rank
+    # parent chains through it even after per-attempt stream clearing.
+    trace_id = env.get(events_lib.TRACE_ID_ENV) \
+        or os.environ.get(events_lib.TRACE_ID_ENV) \
+        or events_lib.new_trace_id()
+    env[events_lib.TRACE_ID_ENV] = trace_id
+    trace_root = events_lib.new_span_id()
+    trace_spans: list[dict] = [
+        {"span_id": trace_root, "parent_id": None, "name": "supervise",
+         "t": round(time.time(), 6), "np": np,
+         "script": os.path.basename(script)}]
+
+    def _trace_span(name: str, ship: bool = False, **attrs) -> str:
+        """Record a supervisor-side span in the manifest; ``ship=True``
+        also makes it the env-shipped parent for the next gang attempt."""
+        sid = events_lib.new_span_id()
+        trace_spans.append({"span_id": sid, "parent_id": trace_root,
+                            "name": name, "t": round(time.time(), 6),
+                            **attrs})
+        if ship:
+            env[events_lib.TRACE_PARENT_ENV] = sid
+        try:
+            events_lib.atomic_write_json(
+                os.path.join(event_dir, events_lib.TRACE_MANIFEST_FILE),
+                {"trace_id": trace_id, "root_span_id": trace_root,
+                 "spans": trace_spans})
+        except OSError:
+            pass
+        return sid
+
     if max_skipped_batches is None:
         try:
             max_skipped_batches = int(
@@ -952,8 +1014,16 @@ def supervise(script: str, np: int = 2, args: list[str] | None = None,
         collected events), and the new launch size."""
         nonlocal cur_np, resizes
         _record_resize(cur_np, to_np, rank=dead_rank)
+        # The resize gets its own manifest span, and the flight-recorder
+        # event carries the ids EXPLICITLY: the driver's own process env
+        # is not traced (trace id lives in the CHILD env), so emit()'s
+        # ambient attachment would leave the resize orphaned.
+        resize_span = _trace_span("gang_resize", from_np=cur_np,
+                                  to_np=to_np, reason=reason)
         events_lib.event("gang_resized", from_np=cur_np, to_np=to_np,
-                         reason=reason, dead_rank=dead_rank, probe=probe)
+                         reason=reason, dead_rank=dead_rank, probe=probe,
+                         trace_id=trace_id, span_id=resize_span,
+                         parent_id=trace_root)
         extra_degradations.append({
             "t": round(time.time(), 6), "rank": None, "name": "gang_resized",
             "from_np": cur_np, "to_np": to_np, "reason": reason,
@@ -963,6 +1033,8 @@ def supervise(script: str, np: int = 2, args: list[str] | None = None,
 
     while True:
         # (_run_gang clears attempt N-1's heartbeats/traces before spawning)
+        _trace_span("gang_attempt", ship=True, attempt=restarts + 1,
+                    np=cur_np)
         if metrics_dir:
             telemetry_lib.clear_rank_files(metrics_dir)
         status, results, info = _run_gang(

@@ -20,6 +20,7 @@ from dataclasses import dataclass, field
 import jax
 
 from . import events
+from . import sentinel
 from . import telemetry
 
 log = logging.getLogger("sparkdl_tpu.runner")
@@ -302,6 +303,9 @@ class ThroughputMeter:
             dt = now - self._last_t
             self.step_stats.record(dt)
             global_step_stats.record(dt)
+            # Online drift detection (ISSUE 17): one global read + return
+            # when the sentinel is off — the pinned ≈-free posture.
+            sentinel.observe("step_time", dt)
         self._last_t = now
         self._window.append((now, n_examples))
         if len(self._window) > 50:

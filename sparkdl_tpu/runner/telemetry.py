@@ -512,6 +512,12 @@ class RequestTraceCollector:
         name = rec.get("name")
         if not isinstance(name, str) or not name.startswith("serve_"):
             return
+        if name == "serve_request":
+            # The request's causal envelope span (ISSUE 17): pure trace
+            # parentage, emitted at retirement AFTER serve_decode already
+            # finalized the trace — folding it would re-open a completed
+            # request's state and leak it as a forever-open trace.
+            return
         rid = rec.get("request")
         if rid is None:
             return  # engine-scoped serve_* events carry no request id
@@ -693,6 +699,7 @@ class _Plane:
         self._server = None
         self._server_thread = None
         self._started = False
+        self._t_started: float | None = None  # /healthz uptime anchor
         self._lock = threading.Lock()
         # write_snapshot has two same-process callers (the exporter tick
         # and flush_snapshot from fit_end/postmortem/atexit) and the
@@ -793,6 +800,7 @@ class _Plane:
             if self._started:
                 return self
             self._started = True
+            self._t_started = time.time()
             self.metrics_dir = metrics_dir
             self._history_bytes = None   # re-seed from the (possibly
             self._history_capped = False  # new) dir's on-disk state
@@ -838,6 +846,17 @@ class _Plane:
                             body = json.dumps(
                                 {"error":
                                  f"{type(e).__name__}: {e}"[:300]}).encode()
+                        ctype = "application/json"
+                    elif self.path.startswith("/healthz"):
+                        # Liveness probe (ISSUE 17): cheap 200 that
+                        # never touches the registry — orchestrators
+                        # poll it at a rate /metrics shouldn't pay.
+                        t0 = plane._t_started
+                        body = json.dumps(
+                            {"status": "ok", "pid": os.getpid(),
+                             "rank": events._rank(),
+                             "uptime_s": round(time.time() - t0, 3)
+                             if t0 is not None else None}).encode()
                         ctype = "application/json"
                     else:
                         self.send_error(404)

@@ -37,6 +37,7 @@ from . import chaos
 from . import data as data_lib
 from . import events
 from . import metrics as metrics_lib
+from . import sentinel as sentinel_lib
 from . import telemetry as telemetry_lib
 from .checkpoint import CheckpointManager
 from .failures import TrainingDivergedError
@@ -312,6 +313,9 @@ class RunnerContext:
         # Live telemetry plane (ISSUE 6): env-armed, ≈ free when
         # SPARKDL_METRICS_DIR/PORT are unset (two dict lookups).
         telemetry_lib.maybe_start_from_env()
+        # Online anomaly sentinel (ISSUE 17): same env-armed, ≈-free-when-
+        # off posture — step times feed it via ThroughputMeter.update.
+        sentinel_lib.maybe_arm_from_env()
         events.event("fit_start", start_step=start_step,
                      num_steps=num_steps, n_chips=self.size)
         eval_step = self.make_eval_step(eval_fn) if eval_fn else None

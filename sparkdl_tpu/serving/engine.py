@@ -107,6 +107,7 @@ import threading
 import time
 
 from ..runner import events, telemetry
+from ..runner import sentinel as sentinel_lib
 from .introspect import register_engine
 from .paging import BlockExhausted
 
@@ -263,12 +264,22 @@ REQUEST_SCOPED_EVENTS = frozenset({
     "serve_prefill_retry", "serve_prefill_chunk_retry",
     "serve_reserve_retry", "serve_prefix_seed_failed",
     "serve_request_quarantined", "serve_request_preempted",
-    "serve_admission_block_wait",
+    "serve_admission_block_wait", "serve_request",
 })
 ENGINE_SCOPED_EVENTS = frozenset({
     "serve_reject", "serve_step_retry", "serve_decode_stall",
     "serve_draft", "serve_engine_fatal",
 })
+
+
+def _req_trace(req: "Request") -> dict:
+    """Causal-trace kwargs for a request-scoped emission (ISSUE 17):
+    parent it under the request's admission (``serve_request``) span so
+    the whole lifecycle — queue wait, prefill chunks, preemptions, the
+    final decode span — chains to one node under the run root. {} when
+    tracing is off, keeping untraced streams byte-identical."""
+    sid = getattr(req, "span_id", None)
+    return {"parent_id": sid} if sid else {}
 
 # Request lifecycle states (plain strings — they serialize into events
 # and stats as-is). PREFILLING is the stall-free scheduler's state: the
@@ -334,6 +345,17 @@ class Request:
         self.spec_drafted = 0
         self.spec_accepted = 0
         self._block_stall_t0: float | None = None
+        # Trace context (ISSUE 17): the admission span this request's
+        # serve_* emissions parent under. Minted at submit time on the
+        # CALLER's thread, so the captured parent is the submitter's
+        # enclosing span (or the env-shipped gang-attempt span) — the
+        # engine loop's ambient context would be wrong for every request
+        # but the one it is currently stepping.
+        self.span_id: str | None = None
+        self.parent_span: str | None = None
+        if events.trace_armed():
+            self.span_id = events.new_span_id()
+            self.parent_span = events.current_span_id()
         self._done = threading.Event()
 
     # -- caller-side API --------------------------------------------------
@@ -912,6 +934,7 @@ class GenerationEngine:
                 self.stats["peak_queue_depth"] = depth
             self._work.notify_all()
         self._metric("gauge", "serving_queue_depth", depth)
+        sentinel_lib.observe("queue_depth", float(depth))
         return req
 
     def _reject(self, reason: str, exc_type=RequestRejected):
@@ -972,7 +995,10 @@ class GenerationEngine:
         # k = 0, or a speculative iteration where NO slot drafted
         # anything: the plain decode step (flash-decode economics, no
         # wasted k+1-wide verify window)
+        t0 = time.perf_counter() if sentinel_lib.armed() else None
         toks = self._step_with_isolation()
+        if t0 is not None and toks is not None:
+            sentinel_lib.observe("decode_step", time.perf_counter() - t0)
         if toks is not None:
             self.stats["steps"] += 1
             for slot, req in active:
@@ -1032,6 +1058,10 @@ class GenerationEngine:
         return False
 
     def _loop(self):
+        # Online anomaly sentinel (ISSUE 17): env-armed at loop start,
+        # same posture as fit() — TTFT / decode-step / queue-depth
+        # baselines drift-checked while the engine serves.
+        sentinel_lib.maybe_arm_from_env()
         try:
             while True:
                 with self._work:
@@ -1124,7 +1154,8 @@ class GenerationEngine:
         # re-queued wait — the trace collector sums stints, and phases
         # still total the end-to-end latency.
         wait_s = req.t_admit - req.t_enqueue
-        events.completed_span("serve_queue", wait_s, request=req.id)
+        events.completed_span("serve_queue", wait_s, request=req.id,
+                              **_req_trace(req))
         self._metric("histogram", "serving_queue_wait_s", wait_s)
         return req, slot
 
@@ -1167,7 +1198,8 @@ class GenerationEngine:
         req.slot = None
         req.t_enqueue = time.time()  # new queued stint begins
         self.stats["admission_block_waits"] += 1
-        events.event("serve_admission_block_wait", request=req.id)
+        events.event("serve_admission_block_wait", request=req.id,
+                     **_req_trace(req))
 
     # -- stall-free admission + chunked prefill ---------------------------
     def _admit(self) -> int:
@@ -1232,11 +1264,13 @@ class GenerationEngine:
                     return True  # slot freed — keep admitting others
                 events.event("serve_reserve_retry", request=req.id,
                              attempt=req.failures,
-                             error=f"{type(e).__name__}: {e}"[:200])
+                             error=f"{type(e).__name__}: {e}"[:200],
+                             **_req_trace(req))
                 self._requeue_for_blocks(req, slot)
                 return False
             events.event("serve_prefix_seed_failed", request=req.id,
-                         error=f"{type(e).__name__}: {e}"[:200])
+                         error=f"{type(e).__name__}: {e}"[:200],
+                         **_req_trace(req))
             start = 0
         dt = time.perf_counter() - t0
         self._note_stall(dt, n_running)
@@ -1365,7 +1399,8 @@ class GenerationEngine:
                 events.event("serve_prefill_chunk_retry", request=req.id,
                              chunk=req.next_chunk, offset=offset,
                              attempt=req.failures,
-                             error=f"{type(e).__name__}: {e}"[:200])
+                             error=f"{type(e).__name__}: {e}"[:200],
+                             **_req_trace(req))
             return
         dt = time.perf_counter() - t0
         self._note_stall(dt, n_running)
@@ -1395,7 +1430,7 @@ class GenerationEngine:
                 "serve_prefill", req.prefill_spent_s, request=req.id,
                 slot=req.slot, bucket=req.bucket, rows=1,
                 chunks=len(req.chunk_plan), reused=req.prefill_reused,
-                wait_s=round(wait_s, 6))
+                wait_s=round(wait_s, 6), **_req_trace(req))
             self._deliver(req, int(tok))
 
     def _prefill_with_retries(self, req: Request, slot: int) -> bool:
@@ -1410,7 +1445,8 @@ class GenerationEngine:
             t0 = time.perf_counter()
             try:
                 with events.span("serve_prefill", request=req.id, slot=slot,
-                                 bucket=req.bucket, rows=1):
+                                 bucket=req.bucket, rows=1,
+                                 **_req_trace(req)):
                     first = self._timed(
                         lambda: self.backend.prefill(slot, served,
                                                      req.bucket),
@@ -1451,7 +1487,8 @@ class GenerationEngine:
                     self.stats["prefill_retries"] += 1
                     events.event("serve_prefill_retry", request=req.id,
                                  attempt=attempt + 1,
-                                 error=f"{type(e).__name__}: {e}"[:200])
+                                 error=f"{type(e).__name__}: {e}"[:200],
+                                 **_req_trace(req))
         self._quarantine(req, last)
         return False
 
@@ -1468,8 +1505,9 @@ class GenerationEngine:
         events.event("serve_request_quarantined", request=req.id,
                      failures=req.failures,
                      error=f"{type(cause).__name__}: {cause}"[:200]
-                     if cause else "?")
+                     if cause else "?", **_req_trace(req))
         self._metric("counter", "serving_requests_quarantined_total")
+        self._close_request_span(req, "quarantined")
         req._done.set()
 
     # -- decode step ------------------------------------------------------
@@ -1713,7 +1751,8 @@ class GenerationEngine:
         self.stats["preemptions"] += 1
         events.event("serve_request_preempted", request=victim.id,
                      generated=len(victim.tokens),
-                     decode_s=round(stint_decode_s, 6))
+                     decode_s=round(stint_decode_s, 6),
+                     **_req_trace(victim))
         self._metric("counter", "serving_requests_preempted_total")
         return victim
 
@@ -1740,6 +1779,7 @@ class GenerationEngine:
             req.t_first_token = now
             self._metric("histogram", "serving_ttft_s",
                          now - req.t_submit)
+            sentinel_lib.observe("ttft", now - req.t_submit)
         if req.stream_cb is not None:
             try:
                 req.stream_cb(req, tok)
@@ -1751,6 +1791,25 @@ class GenerationEngine:
             self._retire(req, "eos")
         elif len(req.tokens) >= req.max_new_tokens:
             self._retire(req, "length")
+
+    def _close_request_span(self, req: Request, finish: str):
+        """Land the request's causal-envelope span (ISSUE 17): one
+        ``serve_request`` span covering submit→done, carrying the
+        admission span id every other emission for this request parents
+        under, itself parented at the submitter's context (or the
+        env-shipped gang-attempt span). Only when tracing is armed — and
+        deliberately WITHOUT an ``error`` attr even for quarantines:
+        merge_timeline reads error-bearing records as failure evidence,
+        and a per-request quarantine already narrates itself via
+        ``serve_request_quarantined``."""
+        if not req.span_id or req.t_done is None:
+            return
+        kw: dict = {"request": req.id, "finish": finish,
+                    "span_id": req.span_id}
+        if req.parent_span:
+            kw["parent_id"] = req.parent_span
+        events.completed_span("serve_request",
+                              max(0.0, req.t_done - req.t_submit), **kw)
 
     def _release_slot(self, slot: int | None):
         if slot is None:
@@ -1792,7 +1851,9 @@ class GenerationEngine:
             attrs["spec_accepted"] = req.spec_accepted
         if req.preemptions:
             attrs["preemptions"] = req.preemptions
+        attrs.update(_req_trace(req))
         events.completed_span("serve_decode", decode_s, **attrs)
+        self._close_request_span(req, reason)
         self._metric("counter", "serving_requests_completed_total")
         self._metric("histogram", "serving_request_latency_s",
                      req.t_done - req.t_submit)

@@ -17,6 +17,7 @@ import re
 import sys
 import threading
 import time
+import urllib.error
 import urllib.request
 
 import pytest
@@ -229,6 +230,51 @@ class TestExporterLifecycle:
             f"http://127.0.0.1:{port}/metrics.json", timeout=10).read())
         assert js["stages"]["fetch"]["rows"] == 4
         telemetry.stop()
+
+    def test_healthz_endpoint(self):
+        """ISSUE 17: /healthz answers 200 with pid + uptime next to
+        /metrics — the cheap liveness probe orchestrators can poll at a
+        rate the full snapshot endpoint shouldn't pay."""
+        telemetry.start(port=0)
+        port = telemetry.server_port()
+        resp = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10)
+        assert resp.status == 200
+        body = json.loads(resp.read())
+        assert body["status"] == "ok"
+        assert body["pid"] == os.getpid()
+        assert body["rank"] == 0
+        assert isinstance(body["uptime_s"], (int, float))
+        assert body["uptime_s"] >= 0
+        # unknown paths still 404 — /healthz did not become a catch-all
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/nope", timeout=10)
+        telemetry.stop()
+
+    def test_healthz_bind_failure_degrades(self, tmp_path):
+        """A taken port must degrade to no-endpoint (port=None) while the
+        rest of the plane — exporter, registry, tee — keeps running; the
+        same never-kill rule the /metrics endpoint pins."""
+        import socket
+        sock = socket.socket()
+        sock.bind(("127.0.0.1", 0))
+        sock.listen(1)
+        taken = sock.getsockname()[1]
+        try:
+            telemetry.start(metrics_dir=str(tmp_path / "m"), port=taken)
+            assert telemetry.server_port() is None  # degraded, not dead
+            assert telemetry.enabled()
+            with events.span("pad"):
+                pass
+            telemetry.flush_snapshot()
+            snap = json.load(
+                open(os.path.join(str(tmp_path / "m"),
+                                  "metrics_rank0.json")))
+            assert snap["stages"]["pad"]["count"] == 1
+        finally:
+            sock.close()
+            telemetry.stop()
 
     def test_maybe_start_from_env(self, tmp_path, monkeypatch):
         assert telemetry.maybe_start_from_env() is False  # nothing set
