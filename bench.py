@@ -1123,6 +1123,31 @@ def _elastic_block(budget=None) -> dict:
             budget.leg_times["elastic"] = round(time.monotonic() - t0, 1)
 
 
+def _quant_block(budget=None) -> dict:
+    """Quantized-serving evidence (ISSUE 18) for ``failure_stats``: the
+    ``scripts/serve_smoke.py quant_block`` leg — a paged + speculative
+    tiny-llama engine at int8 KV + int8 weights vs f32 on CPU, yielding
+    the greedy-stream agreement (gate >= 0.8 lcp fraction), the
+    speculative accept-rate pair + delta (the end-to-end quality
+    monitor) and the pool-blocks multiplier at equal ``kv_pool_mb``.
+    ``BENCH_SKIP_QUANT=1`` skips; the leg costs ~1 min of tiny-model
+    CPU serving, so it yields when the wall budget is nearly spent;
+    any failure is reported in-band — never fatal to the record."""
+    if os.environ.get("BENCH_SKIP_QUANT"):
+        return {"skipped": "env"}
+    if budget is not None and budget.remaining() < 120:
+        return {"skipped": "budget",
+                "detail": f"{budget.remaining():.0f}s left"}
+    t0 = time.monotonic()
+    try:
+        return _load_script_module("serve_smoke.py").quant_block()
+    except Exception as e:  # noqa: BLE001 — in-band, never fatal
+        return {"error": f"{type(e).__name__}: {e}"[:300]}
+    finally:
+        if budget is not None:
+            budget.leg_times["quant"] = round(time.monotonic() - t0, 1)
+
+
 def _worker_serve() -> dict:
     """Continuous-batching serving leg (ISSUE 8): aggregate tokens/s at
     closed-loop concurrency 1/8/32 through ``serving.GenerationEngine``
@@ -1212,6 +1237,12 @@ def _serve_headline(serve: dict) -> dict:
                       "serve_paged_kernel_attn_bytes_ratio")):
         if pk.get(src) is not None:
             out[dst] = pk[src]
+    # ISSUE 18: quantized-KV bytes model from the churn sub-leg — the
+    # per-step f32/quant traffic multiplier at equal positions read
+    # (>= 2x acceptance for int8). Named *_x, NOT *_ratio: bench_trend
+    # infers direction from the name and this one is higher-is-better.
+    if pk.get("kv_quant_bytes_ratio") is not None:
+        out["serve_kv_quant_bytes_x"] = pk["kv_quant_bytes_ratio"]
     lpk = serve.get("paged_kernel") or {}
     if lpk.get("token_identical") is not None:
         out["serve_paged_kernel_token_identical"] = lpk["token_identical"]
@@ -1706,6 +1737,23 @@ def main():
     # Elastic gang supervision (ISSUE 16): resizes / final world size /
     # exactly-once verdict from the jax-free policy leg.
     fs["elastic"] = _elastic_block(budget)
+    # Quantized serving (ISSUE 18): int8-vs-f32 greedy agreement,
+    # accept-rate delta and the equal-MB pool-blocks multiplier. The
+    # numeric scalars ALSO land top-level in extra so bench_trend's
+    # series gate watches them (nested failure_stats dicts are not
+    # picked up by its extra[] scan): *_x / *_frac read higher-is-
+    # better, the accept delta is named *_skew so the trend gate
+    # treats growth as a regression.
+    fs["quant"] = _quant_block(budget)
+    q = fs["quant"]
+    if isinstance(q, dict) and not q.get("error") \
+            and not q.get("skipped"):
+        for src, dst in (
+                ("token_match_frac", "serve_quant_token_match_frac"),
+                ("effective_blocks_x", "serve_quant_effective_blocks_x"),
+                ("accept_rate_delta", "serve_quant_accept_skew")):
+            if isinstance(q.get(src), (int, float)):
+                extra[dst] = q[src]
     extra["failure_stats"] = fs
     extra["budget"] = {"wall_s": budget.wall_s,
                        "spent_s": round(budget.spent(), 1),

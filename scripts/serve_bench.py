@@ -568,6 +568,28 @@ def attn_positions_model(workload, block_size: int, max_len: int):
 _BYTES_PER_POSITION = 2 * 4 * 128 * 4 * 2
 
 
+def kv_bytes_per_position(kv_dtype: str | None = None, *,
+                          kv_heads: int = 4, head_dim: int = 128,
+                          layers: int = 2,
+                          block_size: int = _CHURN_BLOCK) -> float:
+    """ISSUE 18 — the bytes one cache position costs at a given KV
+    storage dtype, INCLUDING the amortized per-block scale plane.
+    f32/None is the reference (== ``_BYTES_PER_POSITION`` at the bench
+    model's shape); int8/fp8 store 1-byte codes plus a ``[Hkv, 2]``
+    f32 scale row per block per layer (``8·Hkv·layers / block_size``
+    bytes per position). Deterministic and host-side, like
+    :func:`attn_positions_model` — so the quant/f32 ratio rides the
+    ``backend_unavailable`` records too."""
+    if kv_dtype in (None, "", "float", "f32", "float32"):
+        return float(2 * kv_heads * head_dim * 4 * layers)
+    if kv_dtype not in ("int8", "fp8"):
+        raise ValueError(f"unknown kv_dtype {kv_dtype!r} "
+                         "(float/int8/fp8)")
+    codes = 2 * kv_heads * head_dim * 1 * layers
+    scales = kv_heads * 2 * 4 * layers / block_size
+    return codes + scales
+
+
 def make_churn_workload(n: int, vocab: int = 32000, seed: int = 3):
     """Short-output many-request chat mix: every prompt opens with the
     same 32-token preamble, bodies are short and distinct, outputs 4-8
@@ -587,7 +609,8 @@ def make_churn_workload(n: int, vocab: int = 32000, seed: int = 3):
 
 def run_paged_churn_comparison(n_requests: int = 192,
                                step_s: float = 0.0015,
-                               prefill_tok_s: float = 1e-4) -> dict:
+                               prefill_tok_s: float = 1e-4,
+                               kv_dtype: str | None = None) -> dict:
     """ISSUE 11 acceptance leg, jax-free: the SAME KV byte pool serves
     8 per-slot rows (PR 9 engine — ``8 × max_len`` positions reserved
     up front) vs a paged engine with 32 slots over a block pool of
@@ -678,6 +701,20 @@ def run_paged_churn_comparison(n_requests: int = 192,
     # the stand-in "kernel leg" number (>= 1.0 by construction): the
     # HBM model, NOT the A/A measurement — see honest_label
     paged_kernel["modeled_hbm_speedup"] = paged_kernel["attn_bytes_ratio"]
+    # ISSUE 18 — quantized-KV bytes model: same deterministic position
+    # counts, at the quantized storage's bytes/position (codes + the
+    # amortized per-block scale plane). kv_quant_bytes_ratio is the
+    # per-step f32/quant traffic ratio at EQUAL positions read — the
+    # acceptance observable (>= 2x for int8); it composes with
+    # attn_bytes_ratio (paging win x quant win = total vs gather-f32).
+    qd = kv_dtype or os.environ.get("BENCH_SERVE_KV_DTYPE") or "int8"
+    bpp_q = kv_bytes_per_position(qd)
+    steps = max(1, kernel_on.get("decode_steps") or 1)
+    paged_kernel["kv_dtype"] = qd
+    paged_kernel["attn_bytes_per_step"]["kernel_quant"] = int(
+        kernel_pos * bpp_q // steps)
+    paged_kernel["kv_quant_bytes_ratio"] = round(
+        _BYTES_PER_POSITION / bpp_q, 2)
     if kernel_on.get("tokens_s") and paged.get("tokens_s"):
         paged_kernel["scheduler_invariance_ratio"] = round(
             kernel_on["tokens_s"] / paged["tokens_s"], 2)
@@ -751,12 +788,16 @@ def _run_paged_kernel_worker(n_requests: int) -> dict:
                                "kernel": kernel_pos},
             "bytes_per_position":
                 2 * cfg.num_kv_heads * cfg.head_dim * 4 * cfg.num_layers,
+            "kv_heads": cfg.num_kv_heads, "head_dim": cfg.head_dim,
+            "layers": cfg.num_layers,
+            "kv_dtype": os.environ.get("SPARKDL_SERVE_KV_DTYPE", ""),
             "kernel_knob":
                 os.environ.get("SPARKDL_SERVE_PAGED_KERNEL", "auto")}
 
 
 def run_paged_kernel_comparison(n_requests: int = 12,
-                                timeout_s: float = 300.0) -> dict:
+                                timeout_s: float = 300.0,
+                                kv_dtype: str | None = None) -> dict:
     """ISSUE 15 CPU-llama kernel leg (healthy records): the paged
     engine with the kernel FORCED vs the gather view, one subprocess
     per knob value. On CPU the kernel runs through the Pallas
@@ -775,6 +816,11 @@ def run_paged_kernel_comparison(n_requests: int = 12,
         scrub_serving_env(env)
         env["JAX_PLATFORMS"] = "cpu"
         env["SPARKDL_SERVE_PAGED_KERNEL"] = env_val
+        if kv_dtype:
+            # ISSUE 18 — both workers serve from the QUANTIZED pool, so
+            # token_identical pins interpret-kernel == dequant-gather
+            # at this dtype (the in-kernel dequant correctness pin).
+            env["SPARKDL_SERVE_KV_DTYPE"] = kv_dtype
         args = [sys.executable, os.path.abspath(__file__),
                 "--paged-kernel-worker", "--requests", str(n_requests)]
         out = subprocess.run(args, env=env, capture_output=True,
@@ -794,14 +840,24 @@ def run_paged_kernel_comparison(n_requests: int = 12,
     gp = on["attn_positions"]["gather_view"]
     kp = on["attn_positions"]["kernel"]
     bpp = on["bytes_per_position"]
+    bpp_q = kv_bytes_per_position(
+        kv_dtype, kv_heads=on.get("kv_heads", 4),
+        head_dim=on.get("head_dim", 128),
+        layers=on.get("layers", 2), block_size=_PK_BLOCK) \
+        if kv_dtype else None
     rec = {
         "mode": "llama_paged_kernel",
         "block_size": _PK_BLOCK, "max_len": _PK_MAX_LEN,
         "num_slots": _PK_SLOTS, "requests": n_requests,
+        "kv_dtype": kv_dtype or "float",
         "kernel_on": on["leg"], "kernel_off": off["leg"],
         "token_identical": on["streams"] == off["streams"],
         "attn_bytes": {"gather_view": gp * bpp, "kernel": kp * bpp,
-                       "ratio": round(gp / kp, 2) if kp else None},
+                       "ratio": round(gp / kp, 2) if kp else None,
+                       **({"kernel_quant": int(kp * bpp_q),
+                           "kv_quant_bytes_ratio":
+                               round(bpp / bpp_q, 2)}
+                          if bpp_q else {})},
         "honest_label": (
             "CPU runs the kernel through the Pallas interpreter: this "
             "leg pins engagement + token identity; wall-clock favors "
@@ -1218,7 +1274,8 @@ def run(mode: str = "llama", rows: int | None = None) -> dict:
         try:
             rec["paged_kernel"] = run_paged_kernel_comparison(
                 n_requests=int(os.environ.get("BENCH_PAGED_KERNEL_REQUESTS",
-                                              "12")))
+                                              "12")),
+                kv_dtype=os.environ.get("BENCH_SERVE_KV_DTYPE") or None)
         except Exception as e:  # noqa: BLE001 — the main legs stand
             rec["paged_kernel_error"] = f"{type(e).__name__}: {e}"[:300]
     # ISSUE 14 tensor-parallel leg: a fresh subprocess on the forced
@@ -1252,7 +1309,17 @@ def main(argv=None) -> int:
     ap.add_argument("--paged-kernel-worker", action="store_true",
                     help=argparse.SUPPRESS)  # internal: one knob value
     # per process (run_paged_kernel_comparison spawned us)
+    ap.add_argument("--kv-dtype", default=None,
+                    choices=("float", "int8", "fp8"),
+                    help="KV pool storage dtype for the paged legs "
+                         "(ISSUE 18): the churn leg's quant bytes "
+                         "model uses it, and the real-model paged-"
+                         "kernel leg serves from a pool quantized to "
+                         "it (token identity pinned through the "
+                         "in-kernel dequant)")
     ns = ap.parse_args(argv)
+    if ns.kv_dtype and ns.kv_dtype != "float":
+        os.environ["BENCH_SERVE_KV_DTYPE"] = ns.kv_dtype
     if ns.paged_kernel_worker:
         import jax
         jax.config.update("jax_platforms", "cpu")

@@ -23,6 +23,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import math
+from collections.abc import Mapping
 from typing import Any, Callable, Optional
 
 import flax.linen as nn
@@ -81,23 +82,69 @@ class RMSNorm(nn.Module):
         return (y * scale).astype(x.dtype)
 
 
+class QuantDense(nn.Module):
+    """Weight-quantized Dense (ISSUE 18): when the stored ``kernel`` is
+    int8 the matmul runs against the codes and folds the absmax
+    per-output-channel ``kernel_scale`` AFTER the contraction
+    (``(x @ q)·s`` — the scale is constant down each output column), so
+    no dequantized copy of the weight ever materializes. Param paths
+    mirror ``nn.Dense`` (same ``kernel``/``bias`` names under the same
+    module name), so :func:`quantize_params` converts a float
+    checkpoint in place and the ``parallel.transformer_tp_rules``
+    patterns keyed on ``.../kernel`` still apply; ``kernel_scale``
+    rides alongside and shards with the kernel's output dim where that
+    dim is column-parallel. A float kernel (an unconverted checkpoint)
+    runs the plain dense path unchanged."""
+    features: int
+    use_bias: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (x.shape[-1], self.features), jnp.float32)
+        x = x.astype(self.dtype)
+        if kernel.dtype == jnp.int8:
+            scale = self.param("kernel_scale", nn.initializers.ones,
+                               (self.features,))
+            y = jnp.dot(x, kernel.astype(self.dtype))
+            # f32 accumulate for the dequant multiply, back to dtype —
+            # a bf16 scale would throw away most of the absmax's
+            # precision for free.
+            y = (y * scale.astype(jnp.float32)).astype(self.dtype)
+        else:
+            y = jnp.dot(x, kernel.astype(self.dtype))
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros,
+                              (self.features,))
+            y = y + bias.astype(self.dtype)
+        return y
+
+
 class LoRADense(nn.Module):
     """Dense with optional LoRA: y = xW + (alpha/r)·(xA)B.
 
     A is gaussian-init, B zero-init (adapter starts as identity). The base
     ``kernel`` and the adapters are separate leaves so the base can be frozen
-    (``lora_mask``) while adapters train.
+    (``lora_mask``) while adapters train. ``quant`` ('int8') swaps the
+    base for :class:`QuantDense` — same param paths, dequant folded
+    into the matmul; adapters stay float (they are ~0.1% of params).
     """
     features: int
     rank: int = 0
     alpha: float = 16.0
     use_bias: bool = False
     dtype: Any = jnp.float32
+    quant: Any = None
 
     @nn.compact
     def __call__(self, x):
-        y = nn.Dense(self.features, use_bias=self.use_bias, dtype=self.dtype,
-                     name="base")(x)
+        if self.quant is not None:
+            y = QuantDense(self.features, use_bias=self.use_bias,
+                           dtype=self.dtype, name="base")(x)
+        else:
+            y = nn.Dense(self.features, use_bias=self.use_bias,
+                         dtype=self.dtype, name="base")(x)
         if self.rank > 0:
             a = nn.Dense(self.rank, use_bias=False, dtype=self.dtype,
                          kernel_init=nn.initializers.normal(0.02),
@@ -178,6 +225,135 @@ def _dense_slot_attention(q, k_all, v_all, qpos, pads, cfg, dtype):
         B, cfg.num_heads, S, hd)
 
 
+# ---------------------------------------------------------------------------
+# Block-quantized KV (ISSUE 18): the paged pool's K/V leaves store
+# int8 (or fp8) CODES and a parallel ``kv_scale`` [pool_blocks, Hkv, 2]
+# f32 plane holds one absmax scale per (physical block, kv head,
+# K-or-V): dequant is codes·scale. The scale is a property of the
+# PHYSICAL block, so radix grafts (table pointer copies) and
+# copy-on-write (block row copies) move scales with their codes for
+# free, and the flash-decode kernel dequantizes in-VMEM — no float
+# copy of the cache ever exists in HBM.
+# ---------------------------------------------------------------------------
+
+KV_QUANT_DTYPES: dict = {"int8": (jnp.int8, 127.0)}
+if hasattr(jnp, "float8_e4m3fn"):
+    KV_QUANT_DTYPES["fp8"] = (jnp.float8_e4m3fn, 448.0)
+
+
+def kv_quant_spec(name: str):
+    """(storage dtype, qmax) for a KV quant mode name — raises with the
+    available modes on a miss (e.g. ``fp8`` on a jax without
+    ``float8_e4m3fn``), never silently falls back."""
+    try:
+        return KV_QUANT_DTYPES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown KV quant dtype {name!r}; available: "
+            f"{sorted(KV_QUANT_DTYPES)}") from None
+
+
+def kv_quant_name(dtype) -> Optional[str]:
+    """Quant mode name for a stored K/V dtype, or None for a float
+    cache — quantization is detected from the POOL, not a model flag,
+    so one compiled model serves both."""
+    for name, (dt, _) in KV_QUANT_DTYPES.items():
+        if jnp.dtype(dtype) == jnp.dtype(dt):
+            return name
+    return None
+
+
+def _kv_qmax(dtype) -> float:
+    for dt, qmax in KV_QUANT_DTYPES.values():
+        if jnp.dtype(dtype) == jnp.dtype(dt):
+            return qmax
+    raise ValueError(f"not a KV quant storage dtype: {dtype}")
+
+
+def _requant(x, qdt, qmax):
+    """f32 values (already divided by scale) → codes: round+clip for
+    int storage, clip+cast for fp8 (the cast itself rounds)."""
+    if jnp.issubdtype(jnp.dtype(qdt), jnp.integer):
+        x = jnp.round(x)
+    return jnp.clip(x, -qmax, qmax).astype(qdt)
+
+
+def _quant_insert_rows(codes, plane, ch, blk, off, rows):
+    """Insert float ``rows`` [N, Hkv, hd] at pool positions
+    ``(blk[n], :, off[n], :)`` of a quantized ``codes`` leaf,
+    maintaining the shared per-(block, head) scale ``plane[..., ch]``
+    (ch 0 = K, 1 = V). ONE routine serves the in-layer decode/verify
+    writes, the chunk-prefill scatter and the blocking-prefill scatter.
+
+    Scale discipline, in scatter order:
+    1. an ``off == 0`` row is a block's FIRST write (positions fill
+       sequentially under the write-frontier invariant), so its scale
+       resets to 0 — a freed-then-reallocated block must not inherit
+       the previous tenant's (possibly larger) scale forever;
+    2. scatter-max of the incoming rows' absmax/qmax grows the scale
+       (duplicate blocks in ``blk`` accumulate — a multi-row write into
+       one block yields the block's true absmax);
+    3. surviving rows of every touched block requantize by
+       old_s/new_s — exact (round of an integer) when the scale did
+       not grow, one ≤½-LSB rounding when it did; ratio 0 (fresh or
+       virgin block) wipes stale codes;
+    4. the new rows quantize at the final scale.
+    Trash-routed rows (blk == 0) follow the same path — block 0 is
+    never read live, and duplicate trash writes stay deterministic
+    (identical content per duplicate). Returns ``(codes, plane)``."""
+    qdt = codes.dtype
+    qmax = _kv_qmax(qdt)
+    rows = rows.astype(jnp.float32)
+    first = off == 0
+    plane = plane.at[jnp.where(first, blk, 0), :, ch].set(0.0)
+    amax = jnp.max(jnp.abs(rows), axis=-1)          # [N, Hkv]
+    old_s = plane[blk, :, ch]
+    plane = plane.at[blk, :, ch].max(amax / qmax)
+    new_s = plane[blk, :, ch]
+    safe = jnp.maximum(new_s, 1e-30)
+    ratio = jnp.where(new_s > 0, old_s / safe, 0.0)
+    cur = codes[blk].astype(jnp.float32) * ratio[:, :, None, None]
+    codes = codes.at[blk].set(_requant(cur, qdt, qmax))
+    q = _requant(rows / safe[:, :, None], qdt, qmax)
+    return codes.at[blk, :, off, :].set(q), plane
+
+
+def _gather_dequant(leaf, plane, ch, tables, dtype):
+    """Dense dequantized per-slot view of one quantized pool leaf —
+    the quant twin of :func:`_gather_leaf`: gather codes through the
+    tables, multiply by each block's per-head scale, cast to the
+    compute dtype. Kernel-fallback and reference-view path only (the
+    kernel itself dequantizes in-VMEM)."""
+    v = _gather_leaf(leaf, tables).astype(jnp.float32)
+    s = plane[tables][..., ch]                       # [B, MB, Hkv]
+    s = jnp.repeat(jnp.transpose(s, (0, 2, 1)), leaf.shape[2], axis=2)
+    return (v * s[..., None]).astype(dtype)
+
+
+def _map_attn_dicts(fn, tree, *rest):
+    """tree_map at the attention-DICT level: apply ``fn`` to every
+    mapping holding both "k" and "v" (the per-layer cache dicts),
+    recursing elsewhere; ``rest`` trees zip-walk by key. The quantized
+    pool needs cross-leaf work (codes and ``kv_scale`` move together,
+    and the scatter's dense twin LACKS the scale leaf), which
+    leaf-level ``tree_map`` cannot express."""
+    if isinstance(tree, Mapping):
+        if "k" in tree and "v" in tree:
+            return fn(dict(tree), *[dict(r) for r in rest])
+        return {k: _map_attn_dicts(fn, v, *[r[k] for r in rest])
+                for k, v in tree.items()}
+    return tree
+
+
+def _pool_quant(pool) -> Optional[str]:
+    """KV quant mode of a pool ('int8'/'fp8'/None) from its stored K/V
+    dtype."""
+    for leaf in jax.tree_util.tree_leaves(pool):
+        if getattr(leaf, "ndim", 0) == 4:
+            return kv_quant_name(leaf.dtype)
+    raise ValueError("pool holds no 4-D K/V leaves")
+
+
 class LlamaAttention(nn.Module):
     cfg: LlamaConfig
     dtype: Any = jnp.float32
@@ -190,6 +366,9 @@ class LlamaAttention(nn.Module):
     # instead (parallel.sharding.head_sharded_kernel). None everywhere
     # else — the single-device paths are untouched.
     kernel_mesh: Any = None
+    # 'int8' → projection base kernels run QuantDense (ISSUE 18); pair
+    # with params converted by quantize_params.
+    weight_quant: Any = None
 
     @nn.compact
     def __call__(self, x, positions, decode: bool = False, pad_lens=None,
@@ -201,7 +380,8 @@ class LlamaAttention(nn.Module):
 
         def proj(name, heads, lora):
             dense = LoRADense(heads * hd, rank=c.lora_rank if lora else 0,
-                              alpha=c.lora_alpha, dtype=d, name=name)
+                              alpha=c.lora_alpha, dtype=d,
+                              quant=self.weight_quant, name=name)
             out = dense(x)
             return out.reshape(B, S, heads, hd).transpose(0, 2, 1, 3)
 
@@ -303,29 +483,65 @@ class LlamaAttention(nn.Module):
                     bi = qpos // bs_p
                     blk = _table_blocks(block_tables, bi, bi < mb)
                     off = qpos % bs_p
-                    k_pool = k_cache.value.at[blk, :, off, :].set(
-                        k.transpose(0, 2, 1, 3).astype(
-                            k_cache.value.dtype))
-                    v_pool = v_cache.value.at[blk, :, off, :].set(
-                        v.transpose(0, 2, 1, 3).astype(
-                            v_cache.value.dtype))
+                    quant = kv_quant_name(k_cache.value.dtype)
+                    scl = None
+                    if quant is None:
+                        k_pool = k_cache.value.at[blk, :, off, :].set(
+                            k.transpose(0, 2, 1, 3).astype(
+                                k_cache.value.dtype))
+                        v_pool = v_cache.value.at[blk, :, off, :].set(
+                            v.transpose(0, 2, 1, 3).astype(
+                                v_cache.value.dtype))
+                    else:
+                        # QUANTIZED pool (ISSUE 18): the leaves are
+                        # codes and the ``kv_scale`` plane rides the
+                        # same cache collection — declared here (only
+                        # on the quantized paged path) so mut["cache"]
+                        # carries it and float pools keep their exact
+                        # pytree. Rows flatten to [B·S] for the shared
+                        # insert primitive.
+                        kv_scale = self.variable(
+                            "cache", "kv_scale", jnp.zeros,
+                            (k_cache.value.shape[0], c.num_kv_heads, 2),
+                            jnp.float32)
+                        fb, fo = blk.reshape(-1), off.reshape(-1)
+                        kr = k.transpose(0, 2, 1, 3).reshape(
+                            -1, c.num_kv_heads, hd)
+                        vr = v.transpose(0, 2, 1, 3).reshape(
+                            -1, c.num_kv_heads, hd)
+                        scl = kv_scale.value
+                        k_pool, scl = _quant_insert_rows(
+                            k_cache.value, scl, 0, fb, fo, kr)
+                        v_pool, scl = _quant_insert_rows(
+                            v_cache.value, scl, 1, fb, fo, vr)
+                        kv_scale.value = scl
                     k_cache.value, v_cache.value = k_pool, v_pool
                     from ..ops import paged_flash_decode as pfd
                     o = None
                     dec = pfd.paged_decode_fn_for(resolved_attn,
                                                   self.kernel_mesh)
                     if dec is not None:
-                        if pfd.supports(bs_p):
+                        reason = pfd.support_reason(bs_p, kv_dtype=quant)
+                        if reason is None:
+                            # the scale plane rides positionally so the
+                            # head-sharded shard_map wrapper shards it
+                            # with its heads (float pools pass nothing).
+                            extra = () if scl is None else (scl,)
                             o = dec(q, k_pool, v_pool, block_tables,
-                                    slot_cur, pads)
+                                    slot_cur, pads, *extra)
                         elif pfd.kernel_mode() == "force":
-                            pfd.warn_fallback(
-                                f"block_size {bs_p} fails supports()")
+                            pfd.warn_fallback(reason)
                     if o is None:
-                        o = _dense_slot_attention(
-                            q, _gather_leaf(k_pool, block_tables),
-                            _gather_leaf(v_pool, block_tables),
-                            qpos, pads, c, d)
+                        if quant is None:
+                            k_all = _gather_leaf(k_pool, block_tables)
+                            v_all = _gather_leaf(v_pool, block_tables)
+                        else:
+                            k_all = _gather_dequant(k_pool, scl, 0,
+                                                    block_tables, d)
+                            v_all = _gather_dequant(v_pool, scl, 1,
+                                                    block_tables, d)
+                        o = _dense_slot_attention(q, k_all, v_all,
+                                                  qpos, pads, c, d)
                 else:
                     max_len = k_cache.value.shape[2]
                     rows_ix = jnp.arange(B)[:, None]
@@ -457,24 +673,30 @@ class LlamaAttention(nn.Module):
         o = o.transpose(0, 2, 1, 3).reshape(B, S, c.num_heads * hd)
         return LoRADense(c.hidden_size, rank=c.lora_rank if "o_proj" in
                          c.lora_targets else 0, alpha=c.lora_alpha,
-                         dtype=d, name="o_proj")(o)
+                         dtype=d, quant=self.weight_quant,
+                         name="o_proj")(o)
 
 
 class LlamaMLP(nn.Module):
     cfg: LlamaConfig
     dtype: Any = jnp.float32
+    weight_quant: Any = None
 
     @nn.compact
     def __call__(self, x):
         c, d = self.cfg, self.dtype
         lr = c.lora_rank
+        wq = self.weight_quant
         gate = LoRADense(c.intermediate_size, rank=lr if "gate_proj" in
-                         c.lora_targets else 0, dtype=d, name="gate_proj")(x)
+                         c.lora_targets else 0, dtype=d, quant=wq,
+                         name="gate_proj")(x)
         up = LoRADense(c.intermediate_size, rank=lr if "up_proj" in
-                       c.lora_targets else 0, dtype=d, name="up_proj")(x)
+                       c.lora_targets else 0, dtype=d, quant=wq,
+                       name="up_proj")(x)
         h = nn.silu(gate) * up
         return LoRADense(c.hidden_size, rank=lr if "down_proj" in
-                         c.lora_targets else 0, dtype=d, name="down_proj")(h)
+                         c.lora_targets else 0, dtype=d, quant=wq,
+                         name="down_proj")(h)
 
 
 class LlamaLayer(nn.Module):
@@ -482,6 +704,7 @@ class LlamaLayer(nn.Module):
     dtype: Any = jnp.float32
     attn_fn: Any = "auto"
     kernel_mesh: Any = None
+    weight_quant: Any = None
 
     @nn.compact
     def __call__(self, x, positions, decode: bool = False, pad_lens=None,
@@ -489,10 +712,13 @@ class LlamaLayer(nn.Module):
                  block_tables=None):
         c = self.cfg
         x = x + LlamaAttention(c, self.dtype, self.attn_fn,
-                               self.kernel_mesh, name="attn")(
+                               self.kernel_mesh,
+                               weight_quant=self.weight_quant,
+                               name="attn")(
             RMSNorm(c.rms_norm_eps, name="attn_norm")(x), positions, decode,
             pad_lens, first_chunk, slot_cur, block_tables)
-        x = x + LlamaMLP(c, self.dtype, name="mlp")(
+        x = x + LlamaMLP(c, self.dtype, weight_quant=self.weight_quant,
+                         name="mlp")(
             RMSNorm(c.rms_norm_eps, name="mlp_norm")(x))
         return x
 
@@ -503,6 +729,7 @@ class LlamaModel(nn.Module):
     dtype: Any = jnp.float32
     attn_fn: Any = "auto"  # flash on TPU, dense elsewhere; or a callable
     kernel_mesh: Any = None  # Mesh(('tp',)) → shard_map decode kernels
+    weight_quant: Any = None  # 'int8' + quantize_params → int8 matmuls
 
     @nn.compact
     def __call__(self, input_ids, decode: bool = False, pad_lens=None,
@@ -558,6 +785,7 @@ class LlamaModel(nn.Module):
                      name="embed_tokens")(input_ids)
         for i in range(c.num_layers):
             x = LlamaLayer(c, self.dtype, self.attn_fn, self.kernel_mesh,
+                           weight_quant=self.weight_quant,
                            name=f"layer_{i}")(x, positions, decode,
                                               pad_lens, first_chunk,
                                               slot_cur, block_tables)
@@ -1060,8 +1288,37 @@ def slot_verify_step(model, params, cache, tokens, slot_cur, pad_lens):
 # primitives pin).
 
 
+def paged_pool_spec(model: LlamaModel, pool_blocks: int, block_size: int,
+                    kv_quant: Optional[str] = None):
+    """``ShapeDtypeStruct`` pytree of the paged pool — the single
+    source of truth for allocation (:func:`init_paged_pool`) AND byte
+    accounting (``serving.backend.pool_bytes_per_block``). With
+    ``kv_quant`` ('int8'/'fp8') the K/V leaves store codes in the
+    quant dtype and every attention dict gains a ``kv_scale``
+    ``[pool_blocks, Hkv, 2]`` f32 plane (``[..., 0]`` = K scales,
+    ``[..., 1]`` = V — one absmax scale per physical block per kv
+    head)."""
+    shapes = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((int(pool_blocks), int(block_size)),
+                                     jnp.int32), decode=True))["cache"]
+    if kv_quant is None:
+        return shapes
+    qdt, _ = kv_quant_spec(kv_quant)
+
+    def q(attn):
+        for name in ("k", "v"):
+            attn[name] = jax.ShapeDtypeStruct(attn[name].shape, qdt)
+        p, hkv = attn["k"].shape[:2]
+        attn["kv_scale"] = jax.ShapeDtypeStruct((p, hkv, 2), jnp.float32)
+        return attn
+
+    return _map_attn_dicts(q, shapes)
+
+
 def init_paged_pool(model: LlamaModel, pool_blocks: int, block_size: int,
-                    kv_sharding=None, scalar_sharding=None):
+                    kv_sharding=None, scalar_sharding=None,
+                    kv_quant: Optional[str] = None, scale_sharding=None):
     """Zeroed shared K/V pool: per layer ``[pool_blocks, kv_heads,
     block_size, head_dim]`` — structurally a ``init_cache`` with
     batch=pool_blocks and max_len=block_size, which is exactly the
@@ -1070,10 +1327,25 @@ def init_paged_pool(model: LlamaModel, pool_blocks: int, block_size: int,
     it, so masked garbage writes land where no request reads.
     ``kv_sharding`` places every block's ``Hkv`` axis over a tp mesh —
     block ids stay logical/device-count-agnostic, each device holds
-    ``1/tp`` of every block (see :func:`init_cache`)."""
-    return init_cache(model, int(pool_blocks), int(block_size),
-                      kv_sharding=kv_sharding,
-                      scalar_sharding=scalar_sharding)
+    ``1/tp`` of every block (see :func:`init_cache`).
+
+    ``kv_quant`` stores K/V as codes with a per-block ``kv_scale``
+    plane (:func:`paged_pool_spec`); ``scale_sharding`` places the 3-D
+    plane leaves — the tp backends shard them over the same head axis
+    as their codes."""
+    spec = paged_pool_spec(model, pool_blocks, block_size, kv_quant)
+
+    def make(s):
+        nd = len(s.shape)
+        sh = {4: kv_sharding, 3: scale_sharding}.get(nd, scalar_sharding)
+        if sh is not None:
+            return jax.make_array_from_callback(
+                s.shape, sh, lambda idx: np.zeros(
+                    tuple(len(range(*i.indices(d)))
+                          for i, d in zip(idx, s.shape)), s.dtype))
+        return jnp.zeros(s.shape, s.dtype)
+
+    return jax.tree_util.tree_map(make, spec)
 
 
 def _pool_block_size(pool) -> int:
@@ -1092,13 +1364,24 @@ def _gather_view(pool, tables):
     15 the decode/verify primitives route the pool straight into
     ``apply()`` (writes and reads go through the table in-layer, the
     kernel fuses the gather away); this tree-level view remains the
-    REFERENCE the equivalence tests compare against."""
-    def g(leaf):
-        if getattr(leaf, "ndim", 0) == 4:
-            return _gather_leaf(leaf, tables)
-        return jnp.zeros((), jnp.int32)
+    REFERENCE the equivalence tests compare against. A quantized pool
+    yields the DEQUANTIZED f32 view (codes·per-block scale) — the
+    reference the interpret-mode kernel pins run against."""
+    def g_attn(attn):
+        plane = attn.get("kv_scale")
+        out = {}
+        for name, leaf in attn.items():
+            if getattr(leaf, "ndim", 0) != 4:
+                out[name] = jnp.zeros((), jnp.int32)
+            elif plane is None:
+                out[name] = _gather_leaf(leaf, tables)
+            else:
+                out[name] = _gather_dequant(
+                    leaf, plane, 0 if name == "k" else 1, tables,
+                    jnp.float32)
+        return out
 
-    return jax.tree_util.tree_map(g, pool)
+    return _map_attn_dicts(g_attn, pool)
 
 
 @functools.partial(
@@ -1203,23 +1486,41 @@ def paged_prefill_chunk_into_slot(model, params, chunk_ids, pool,
     # and only real positions scatter back to the pool.
     wb = -(-int(window) // bs)
 
-    def gather(leaf):
-        if getattr(leaf, "ndim", 0) == 4:
+    def gather_attn(attn):
+        plane = attn.get("kv_scale")
+        out = {}
+        for name, leaf in attn.items():
+            if name == "kv_scale":
+                # the dense window view is FLOAT — the model's
+                # non-paged branch (this apply carries no
+                # block_tables) declares only k/v/idx, so the view
+                # must not grow a scale leaf.
+                continue
+            if getattr(leaf, "ndim", 0) != 4:
+                # scalar idx leaves: pin the multi-call decode path's
+                # write index at the chunk's offset (same contract as
+                # the un-paged chunk primitive)
+                out[name] = jnp.asarray(offset, jnp.int32)
+                continue
             mbv = min(wb, table_row.shape[0])
             v = leaf[table_row[:mbv]]              # [mbv, Hkv, bs, hd]
+            if plane is not None:
+                # dequantize the window into the model's compute
+                # dtype; scratch pad rows (below) stay zero — never
+                # read live.
+                s = plane[table_row[:mbv], :, 0 if name == "k" else 1]
+                v = (v.astype(jnp.float32)
+                     * s[:, :, None, None]).astype(model.dtype)
             v = jnp.transpose(v, (1, 0, 2, 3))
             v = v.reshape(1, leaf.shape[1], mbv * bs, leaf.shape[3])
             if wb > mbv:
                 v = jnp.concatenate(
                     [v, jnp.zeros((1, leaf.shape[1], (wb - mbv) * bs,
                                    leaf.shape[3]), v.dtype)], axis=2)
-            return v
-        # scalar idx leaves: pin the multi-call decode path's write
-        # index at the chunk's offset (same contract as the un-paged
-        # chunk primitive)
-        return jnp.asarray(offset, jnp.int32)
+            out[name] = v
+        return out
 
-    row = jax.tree_util.tree_map(gather, pool)
+    row = _map_attn_dicts(gather_attn, pool)
     logits, mut = model.apply({"params": params, "cache": row},
                               chunk_ids, decode=True, mutable=["cache"])
     pos = offset + jnp.arange(c)                   # [C] logical
@@ -1237,16 +1538,27 @@ def paged_prefill_chunk_into_slot(model, params, chunk_ids, pool,
     blk = _table_blocks(table_row, bi, real)
     off = pos % bs
 
-    def scatter(pool_leaf, dense_leaf):
-        if getattr(pool_leaf, "ndim", 0) != 4:
-            return pool_leaf
-        new = jnp.take_along_axis(
-            dense_leaf, pos[None, None, :, None], axis=2)[0]
-        new = jnp.moveaxis(new, 1, 0)              # [C, Hkv, hd]
-        return pool_leaf.at[blk, :, off, :].set(
-            new.astype(pool_leaf.dtype))
+    def scatter_attn(attn, dense):
+        # zip-walk: the dense twin came from the FLOAT window apply, so
+        # it lacks the kv_scale leaf a quantized pool carries — a
+        # leaf-level tree_map would reject the structure mismatch.
+        plane = attn.get("kv_scale")
+        out = dict(attn)
+        for ch, name in enumerate(("k", "v")):
+            new = jnp.take_along_axis(
+                dense[name], pos[None, None, :, None], axis=2)[0]
+            new = jnp.moveaxis(new, 1, 0)          # [C, Hkv, hd]
+            if plane is None:
+                out[name] = attn[name].at[blk, :, off, :].set(
+                    new.astype(attn[name].dtype))
+            else:
+                out[name], plane = _quant_insert_rows(
+                    attn[name], plane, ch, blk, off, new)
+        if plane is not None:
+            out["kv_scale"] = plane
+        return out
 
-    pool = jax.tree_util.tree_map(scatter, pool, mut["cache"])
+    pool = _map_attn_dicts(scatter_attn, pool, mut["cache"])
     last = jax.lax.dynamic_slice(
         logits, (0, jnp.maximum(n_valid - 1, 0), 0),
         (1, 1, logits.shape[2]))[:, 0]
@@ -1282,14 +1594,22 @@ def paged_prefill_into_slot(model, params, prompt_ids, pad_len, pool,
     blk = table_row[pos // bs]
     off = pos % bs
 
-    def scatter(pool_leaf, sm):
-        if getattr(sm, "ndim", 0) != 4:
-            return pool_leaf
-        new = jnp.transpose(sm[0], (1, 0, 2))      # [Lb, Hkv, hd]
-        return pool_leaf.at[blk, :, off, :].set(
-            new.astype(pool_leaf.dtype))
+    def scatter_attn(attn, sm):
+        plane = attn.get("kv_scale")
+        out = dict(attn)
+        for ch, name in enumerate(("k", "v")):
+            new = jnp.transpose(sm[name][0], (1, 0, 2))  # [Lb, Hkv, hd]
+            if plane is None:
+                out[name] = attn[name].at[blk, :, off, :].set(
+                    new.astype(attn[name].dtype))
+            else:
+                out[name], plane = _quant_insert_rows(
+                    attn[name], plane, ch, blk, off, new)
+        if plane is not None:
+            out["kv_scale"] = plane
+        return out
 
-    pool = jax.tree_util.tree_map(scatter, pool, mut["cache"])
+    pool = _map_attn_dicts(scatter_attn, pool, mut["cache"])
     tok = _sample(logits[:, -1].astype(jnp.float32), rng, temperature,
                   top_k, top_p)
     return tok, pool
@@ -1301,16 +1621,66 @@ def copy_pool_block(pool, src, dst):
     copy-on-write primitive: a write that would land in a SHARED block
     (refcount >= 2 after a radix graft) first duplicates it so the
     other holders keep reading the original. ``src``/``dst`` traced —
-    one tiny compiled program per pool signature."""
+    one tiny compiled program per pool signature. The 3-D ``kv_scale``
+    planes of a quantized pool copy with their codes (both are indexed
+    by physical block), so copy-on-write stays EXACT — the duplicate
+    dequantizes bit-identically to the original."""
     def cp(leaf):
-        if getattr(leaf, "ndim", 0) != 4:
+        nd = getattr(leaf, "ndim", 0)
+        if nd not in (3, 4):
             return leaf
         row = jax.lax.dynamic_slice(
-            leaf, (src, 0, 0, 0),
+            leaf, (src,) + (0,) * (nd - 1),
             (1,) + leaf.shape[1:])
-        return jax.lax.dynamic_update_slice(leaf, row, (dst, 0, 0, 0))
+        return jax.lax.dynamic_update_slice(
+            leaf, row, (dst,) + (0,) * (nd - 1))
 
     return jax.tree_util.tree_map(cp, pool)
+
+
+# int8 weight serving (ISSUE 18): the Megatron-sharded projection
+# matmuls — attention q/k/v/o and MLP gate/up/down. lm_head, embed,
+# norms and LoRA adapters stay float (logits keep full precision;
+# adapters are ~0.1% of params).
+WEIGHT_QUANT_TARGETS = frozenset(
+    ("q_proj", "k_proj", "v_proj", "o_proj",
+     "gate_proj", "up_proj", "down_proj"))
+
+
+def quantize_params(params, name: str = "int8"):
+    """Host-side weight quantization: every projection base kernel in
+    ``WEIGHT_QUANT_TARGETS`` → int8 codes + an absmax per-OUTPUT-channel
+    f32 ``kernel_scale`` (``s = max|col| / 127``; an all-zero column
+    gets scale 1 so dequant stays finite). Pair with
+    ``model.clone(weight_quant='int8')`` — :class:`QuantDense` engages
+    on the stored dtype and folds the dequant after each matmul.
+    Returns a new params pytree; everything outside the targets is
+    passed through untouched."""
+    if name != "int8":
+        raise ValueError(
+            f"unsupported weight quant dtype {name!r} (int8 only)")
+
+    def convert(base):
+        kern = jnp.asarray(base["kernel"], jnp.float32)
+        s = jnp.max(jnp.abs(kern), axis=0) / 127.0
+        s = jnp.where(s > 0, s, 1.0)
+        out = dict(base)
+        out["kernel"] = jnp.clip(
+            jnp.round(kern / s), -127, 127).astype(jnp.int8)
+        out["kernel_scale"] = s.astype(jnp.float32)
+        return out
+
+    def walk(tree, parent):
+        if not isinstance(tree, Mapping):
+            return tree
+        return {
+            k: (convert(v) if k == "base"
+                and parent in WEIGHT_QUANT_TARGETS
+                and isinstance(v, Mapping) and "kernel" in v
+                else walk(v, k))
+            for k, v in tree.items()}
+
+    return walk(params, "")
 
 
 # ---------------------------------------------------------------------------

@@ -108,12 +108,24 @@ def _decode_kernel(cur_ref, pad_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0] = (acc_ref[:] / safe_l[:, None]).astype(o_ref.dtype)
 
 
+def support_reason(max_len: int, block_k: int = _LANES) -> str | None:
+    """None when the kernel covers a cache of ``max_len`` slots, else a
+    human-readable reason — what the stand-down path logs so "dense
+    attention was chosen" always says WHY (ISSUE 18 satellite; the
+    ``ops.paged_flash_decode.support_reason`` twin). Capability itself:
+    KV blocks must tile the cache exactly (the dead-block clamp assumes
+    whole blocks); ``init_cache`` sizes are user-chosen."""
+    if max_len < block_k or max_len % block_k:
+        return (f"cache len {max_len} is not tiled by "
+                f"block_k={block_k} (the dead-block clamp needs whole "
+                f"KV blocks)")
+    return None
+
+
 def supports(max_len: int, block_k: int = _LANES) -> bool:
-    """Whether the kernel covers a cache of ``max_len`` slots: KV blocks
-    must tile it exactly (the dead-block clamp assumes whole blocks).
-    ``init_cache`` sizes are user-chosen; non-multiples fall back to the
-    dense path at the call site."""
-    return max_len >= block_k and max_len % block_k == 0
+    """Boolean twin of :func:`support_reason` (kept for call sites that
+    only branch)."""
+    return support_reason(max_len, block_k) is None
 
 
 def flash_decode(q, k_cache, v_cache, cur, pad_lens=None, *,
@@ -141,10 +153,10 @@ def flash_decode(q, k_cache, v_cache, cur, pad_lens=None, *,
     if hq % h_kv:
         raise ValueError(f"Hq={hq} not a multiple of Hkv={h_kv}")
     bk = _LANES if block_k is None else block_k
-    if not supports(max_len, bk):
-        raise ValueError(
-            f"cache len {max_len} not tiled by block_k={bk}; use the "
-            f"dense path (see supports())")
+    reason = support_reason(max_len, bk)
+    if reason is not None:
+        raise ValueError(f"unsupported config ({reason}); use the "
+                         f"dense path (see support_reason())")
     rep = hq // h_kv
     g = max(rep, _MIN_SUBLANES)
     sm_scale = 1.0 / math.sqrt(d)

@@ -61,14 +61,25 @@ PAGED_KERNEL_ENV = "SPARKDL_SERVE_PAGED_KERNEL"
 
 
 def _paged_decode_kernel(tbl_ref, cur_ref, pad_ref, q_ref, k_ref, v_ref,
-                         o_ref, acc_ref, m_ref, l_ref, *, sm_scale: float,
-                         h_kv: int, bs: int, s_q: int, rep: int):
+                         *rest, sm_scale: float, h_kv: int, bs: int,
+                         s_q: int, rep: int, quant: bool = False):
     """Grid = (B·Hkv, max_blocks); the KV BlockSpec index map (below)
     already resolved grid step ``j`` to the pool block the slot's table
     names, so the body is the standard online-softmax update over one
     ``(bs, hd)`` pool block. Rows of the query tile are (query i,
     GQA group g) pairs flattened as ``i * rep + g`` (pad rows clip to
-    the last query and are sliced off outside)."""
+    the last query and are sliced off outside).
+
+    ``quant`` (ISSUE 18): K/V are int8/fp8 CODES and ``rest`` leads
+    with a (1, 2) SMEM ref holding this block's (K, V) scales for this
+    kv head. Dequant folds AFTER each contraction — ``(q·kᵀ)·s_k`` and
+    ``(p·v)·s_v``, exact because the scale is constant over the block —
+    so the kernel reads quantized bytes from HBM and no dequantized
+    block ever exists outside VMEM."""
+    if quant:
+        scl_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
     bh, j = pl.program_id(0), pl.program_id(1)
     n_kv = pl.num_programs(1)
     slot = bh // h_kv
@@ -89,6 +100,8 @@ def _paged_decode_kernel(tbl_ref, cur_ref, pad_ref, q_ref, k_ref, v_ref,
         k = k_ref[0, 0].astype(jnp.float32)               # (bs, D)
         v = v_ref[0, 0].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # (R, bs)
+        if quant:
+            s = s * scl_ref[0, 0]
         rows = q.shape[0]
         col = j * bs + jax.lax.broadcasted_iota(jnp.int32, (rows, bs), 1)
         qi = jnp.minimum(
@@ -105,8 +118,10 @@ def _paged_decode_kernel(tbl_ref, cur_ref, pad_ref, q_ref, k_ref, v_ref,
         p = jnp.where(m_new[:, None] <= NEG_INF, 0.0, p)
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1)
-        acc_ref[:] = acc_ref[:] * alpha[:, None] + jnp.dot(
-            p, v, preferred_element_type=jnp.float32)
+        pv = jnp.dot(p, v, preferred_element_type=jnp.float32)
+        if quant:
+            pv = pv * scl_ref[0, 1]
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + pv
         m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
 
@@ -117,17 +132,37 @@ def _paged_decode_kernel(tbl_ref, cur_ref, pad_ref, q_ref, k_ref, v_ref,
         o_ref[0] = (acc_ref[:] / safe_l[:, None]).astype(o_ref.dtype)
 
 
-def supports(block_size: int) -> bool:
-    """Whether the kernel covers a pool of ``block_size``-position
-    blocks: the per-block KV tile is ``(block_size, head_dim)`` and the
-    sublane dim must stay 8-aligned for Mosaic (the engine's default
-    block_size 16 qualifies; a 4-position pool falls back to the gather
-    view at the call site — the ``ops.flash_decode.supports`` twin)."""
-    return block_size >= 8 and block_size % 8 == 0
+def support_reason(block_size: int,
+                   kv_dtype: str | None = None) -> str | None:
+    """None when the kernel covers the config, else a human-readable
+    reason string — what the stand-down path logs so "dense attention
+    was chosen" always says WHY (ISSUE 18 satellite; the
+    ``ops.flash_decode.support_reason`` twin). Capability itself:
+    the per-block KV tile is ``(block_size, head_dim)`` and the sublane
+    dim must stay 8-aligned for Mosaic (the engine's default
+    block_size 16 qualifies); a quantized pool additionally needs a
+    registered ``kv_dtype`` (the scale-fused kernel variant)."""
+    if block_size < 8 or block_size % 8:
+        return (f"block_size {block_size} is not an 8-multiple >= 8 "
+                f"(the Mosaic sublane constraint on the per-block KV "
+                f"tile)")
+    if kv_dtype is not None:
+        from ..models.llama import KV_QUANT_DTYPES
+        if kv_dtype not in KV_QUANT_DTYPES:
+            return (f"KV quant dtype {kv_dtype!r} has no fused-dequant "
+                    f"kernel variant (available: "
+                    f"{sorted(KV_QUANT_DTYPES)})")
+    return None
+
+
+def supports(block_size: int, kv_dtype: str | None = None) -> bool:
+    """Boolean twin of :func:`support_reason` (kept for call sites that
+    only branch)."""
+    return support_reason(block_size, kv_dtype) is None
 
 
 def paged_flash_decode(q, k_pool, v_pool, tables, slot_cur, pad_lens=None,
-                       *, interpret: bool | None = None):
+                       kv_scales=None, *, interpret: bool | None = None):
     """Block-table cache attention over the shared pool. ``q``:
     ``[B, Hq, S, D]`` — ``S = 1`` is the per-slot decode step,
     ``S = k+1`` the speculative verify window; ``k_pool``/``v_pool``:
@@ -141,6 +176,13 @@ def paged_flash_decode(q, k_pool, v_pool, tables, slot_cur, pad_lens=None,
     logical positions ``[pad_lens[r], slot_cur[r] + i]``. Returns
     ``[B, Hq, S, D]``.
 
+    ``kv_scales`` (ISSUE 18): the quantized pool's
+    ``[pool_blocks, Hkv, 2]`` f32 scale plane — required exactly when
+    the pool leaves hold int8/fp8 codes. Each grid step's (K, V) scale
+    pair rides a (1, 2) SMEM block whose index map chases the table
+    like the KV specs, and dequant folds after the two dots in-kernel:
+    the HBM read stays quantized end to end.
+
     HBM traffic per step is O(cur) per slot: the index map clamps every
     dead grid step to the slot's last live table entry (repeat DMAs are
     skipped) and ``pl.when`` gates its compute off. No dense per-slot
@@ -151,12 +193,20 @@ def paged_flash_decode(q, k_pool, v_pool, tables, slot_cur, pad_lens=None,
 
     b, hq, s_q, d = q.shape
     pool_blocks, h_kv, bs, _ = k_pool.shape
+    quant = kv_scales is not None
+    if not quant and jnp.dtype(k_pool.dtype).itemsize == 1:
+        # int8/fp8 codes without their scale plane would silently
+        # attend over raw code values — refuse loudly instead.
+        raise ValueError(
+            f"pool dtype {jnp.dtype(k_pool.dtype).name} holds quantized "
+            f"codes; pass the [pool_blocks, Hkv, 2] kv_scales plane")
     if hq % h_kv:
         raise ValueError(f"Hq={hq} not a multiple of Hkv={h_kv}")
-    if not supports(bs):
+    reason = support_reason(bs)
+    if reason is not None:
         raise ValueError(
-            f"block_size {bs} unsupported (needs 8-multiple >= 8); use "
-            f"the gather view (see supports())")
+            f"unsupported config ({reason}); use the gather view "
+            f"(see support_reason())")
     if tables.ndim != 2 or tables.shape[0] != b:
         raise ValueError(f"tables must be [B={b}, max_blocks], got "
                          f"shape {tables.shape}")
@@ -189,14 +239,36 @@ def paged_flash_decode(q, k_pool, v_pool, tables, slot_cur, pad_lens=None,
         jc = jnp.minimum(j, last_live)
         return (tbl_ref[slot * mb + jc], bh % h_kv, 0, 0)
 
+    in_specs = [
+        pl.BlockSpec((1, r_pad, d), lambda bh, j, t, c, p: (bh, 0, 0)),
+        pl.BlockSpec((1, 1, bs, d), kv_index),
+        pl.BlockSpec((1, 1, bs, d), kv_index),
+    ]
+    operands = [tbl, cur_arr, pad_arr, q3, k_pool, v_pool]
+    if quant:
+        # Pre-gather the scale pairs into grid order — [B·Hkv·MB, 2]
+        # f32, a few KB riding SMEM two floats per grid step (scalars
+        # stay 2-D there). The index map mirrors kv_index's dead-step
+        # clamp so repeat fetches are skipped the same way.
+        scl = kv_scales[tables]                  # [B, MB, Hkv, 2]
+        scl = scl.transpose(0, 2, 1, 3).reshape(b * h_kv * mb, 2)
+        scl = scl.astype(jnp.float32)
+
+        def scl_index(bh, j, tbl_ref, cur_ref, pad_ref):
+            slot = bh // h_kv
+            last_live = jnp.maximum(
+                pl.cdiv(cur_ref[slot] + s_q, bs) - 1, 0)
+            jc = jnp.minimum(j, last_live)
+            return (bh * mb + jc, 0)
+
+        in_specs.append(pl.BlockSpec((1, 2), scl_index,
+                                     memory_space=pltpu.SMEM))
+        operands.append(scl)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(b * h_kv, mb),
-        in_specs=[
-            pl.BlockSpec((1, r_pad, d), lambda bh, j, t, c, p: (bh, 0, 0)),
-            pl.BlockSpec((1, 1, bs, d), kv_index),
-            pl.BlockSpec((1, 1, bs, d), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, r_pad, d),
                                lambda bh, j, t, c, p: (bh, 0, 0)),
         scratch_shapes=[
@@ -207,11 +279,12 @@ def paged_flash_decode(q, k_pool, v_pool, tables, slot_cur, pad_lens=None,
     )
     o3 = pl.pallas_call(
         functools.partial(_paged_decode_kernel, sm_scale=sm_scale,
-                          h_kv=h_kv, bs=bs, s_q=s_q, rep=rep),
+                          h_kv=h_kv, bs=bs, s_q=s_q, rep=rep,
+                          quant=quant),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b * h_kv, r_pad, d), q.dtype),
         interpret=_resolve(interpret),
-    )(tbl, cur_arr, pad_arr, q3, k_pool, v_pool)
+    )(*operands)
     o = o3[:, :r0].reshape(b, h_kv, s_q, rep, d)
     return o.transpose(0, 1, 3, 2, 4).reshape(b, hq, s_q, d)
 

@@ -115,6 +115,21 @@ def transformer_tp_rules(model_axis: str = "model",
     # (/base)? skips the LoRADense wrapper segment (models/llama.py): the
     # frozen kernel lives at e.g. 'q_proj/base/kernel'.
     rules = make_rules([
+        # kernel_scale rules MUST precede the kernel rules: re.search
+        # lets '.../kernel' match inside '.../kernel_scale', and the
+        # trailing-axis drop would then collapse the 2-D kernel spec
+        # onto the 1-D scale — replicating a column-parallel scale that
+        # must shard with the output channels it scales (QuantDense
+        # int8 serving, ISSUE 18). Row-parallel kernels shard their
+        # INPUT dim, so their per-output scale replicates.
+        (r"(q_proj|k_proj|v_proj|query|key|value)(/base)?/kernel_scale",
+         P(m)),
+        (r"(o_proj|out_proj|attention_output)(/base)?/kernel_scale",
+         P()),
+        (r"(up_proj|gate_proj|intermediate|fc1|mlp_in)(/base)?"
+         r"/kernel_scale", P(m)),
+        (r"(down_proj|output_dense|fc2|mlp_out)(/base)?/kernel_scale",
+         P()),
         (r"(q_proj|k_proj|v_proj|query|key|value)(/base)?/kernel",
          P(None, m)),
         (r"(o_proj|out_proj|attention_output)(/base)?/kernel", P(m, None)),
@@ -223,16 +238,25 @@ def head_sharded_kernel(fn, mesh: Mesh, axis: str = "tp"):
     both layouts. GQA stays exact per shard: the serving layout
     requires ``tp`` to divide both head counts
     (:func:`serving_tp_layout`), so each shard keeps the global
-    Hq/Hkv ratio."""
+    Hq/Hkv ratio. A trailing 3-D operand whose leading two dims match
+    the K operand's is a quantized pool's ``[pool, Hkv, 2]`` scale
+    plane (ISSUE 18) — it shards with its heads like the codes it
+    scales."""
     from jax.experimental.shard_map import shard_map
 
     spec_h = P(None, axis, None, None)
+
+    def rest_spec(r, k):
+        if getattr(r, "ndim", 0) == 3 and r.shape[:2] == k.shape[:2]:
+            return P(None, axis, None)  # per-(block, head) scale plane
+        return P()
 
     def wrapped(q, k, v, *rest, **kw):
         inner = functools.partial(fn, **kw) if kw else fn
         return shard_map(
             inner, mesh=mesh,
-            in_specs=(spec_h, spec_h, spec_h) + tuple(P() for _ in rest),
+            in_specs=(spec_h, spec_h, spec_h)
+            + tuple(rest_spec(r, k) for r in rest),
             out_specs=spec_h, check_rep=False)(q, k, v, *rest)
 
     wrapped.__name__ = f"head_sharded_{getattr(fn, '__name__', 'kernel')}"

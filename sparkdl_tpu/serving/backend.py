@@ -97,6 +97,26 @@ def _tree_sig(tree):
                  for x in jax.tree_util.tree_leaves(tree))
 
 
+def _weight_quantize(self, weight_dtype):
+    """Shared int8-weight hook (ISSUE 18): validate the mode, clone the
+    model with ``weight_quant`` (QuantDense engages on the stored
+    dtype) and convert ``self.params`` host-side. Runs INSIDE each
+    backend ``__init__`` before any jitted call — on the tp backends
+    that is after ``_tp_setup`` (the clone composes with the
+    kernel-mesh clone) and before ``_tp_finish`` (so ``shard_params``
+    places int8 codes + ``kernel_scale`` leaves directly; the
+    column-parallel scale rules live in
+    ``parallel.transformer_tp_rules``)."""
+    self.weight_dtype = weight_dtype
+    if weight_dtype is None:
+        return
+    self.model = self.model.clone(weight_quant=weight_dtype)
+    self.params = L.quantize_params(self.params, weight_dtype)
+    log.info("serving with %s-quantized projection weights "
+             "(absmax per-channel scales, dequant folded after each "
+             "matmul)", weight_dtype)
+
+
 @functools.partial(jax.jit, static_argnames=("rows",))
 def _gather_slot_rows(cache, slot, *, rows: int):
     """Copy ``[0, rows)`` of one slot's K/V rows out of the slot cache —
@@ -150,7 +170,8 @@ class LlamaSlotBackend:
     def __init__(self, model, variables, num_slots: int, max_len: int, *,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, seed: int = 0,
-                 prefix_cache_bytes: int | None = None):
+                 prefix_cache_bytes: int | None = None,
+                 weight_dtype: str | None = None):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         if max_len < 2:
@@ -158,13 +179,20 @@ class LlamaSlotBackend:
         self.model = model
         self.params = variables["params"] if "params" in variables \
             else variables
+        _weight_quantize(self, weight_dtype)
         self.num_slots = int(num_slots)
         self.max_len = int(max_len)
         self.vocab_size = int(model.cfg.vocab_size)
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self.top_p = float(top_p)
-        self.cache = self._make_cache(model)
+        from ..ops import flash_decode as fd
+        reason = fd.support_reason(self.max_len)
+        if reason is not None:
+            log.info("flash-decode kernel stands down for this config "
+                     "(%s); decode steps use dense cache attention",
+                     reason)
+        self.cache = self._make_cache(self.model)
         self._tokens = np.zeros(self.num_slots, np.int32)
         # Idle slots park at fill index 0 — their write frontier: the
         # step's (masked, discarded) write lands exactly where the next
@@ -194,7 +222,9 @@ class LlamaSlotBackend:
         the ``1/tp`` shrink on it."""
         per: dict = {}
         for leaf in jax.tree_util.tree_leaves(self.cache):
-            if getattr(leaf, "ndim", 0) != 4:
+            # 4-D K/V leaves plus a quantized pool's 3-D kv_scale
+            # planes — the scale overhead is part of the budget.
+            if getattr(leaf, "ndim", 0) not in (3, 4):
                 continue
             shards = getattr(leaf, "addressable_shards", None)
             if shards:
@@ -453,17 +483,20 @@ class LlamaSlotBackend:
         self._tokens[slot] = 0
 
 
-def pool_bytes_per_block(model, block_size: int) -> int:
-    """K/V bytes one physical block costs across every layer — the
-    ``SPARKDL_SERVE_KV_POOL_MB`` → block-count conversion. Derived via
-    ``eval_shape`` over a 1-block pool (no parameter compute, no
-    allocation)."""
-    import jax as _jax
-    shapes = _jax.eval_shape(
-        lambda: L.init_paged_pool(model, 1, int(block_size)))
+def pool_bytes_per_block(model, block_size: int,
+                         kv_dtype: str | None = None) -> int:
+    """Bytes one physical block costs across every layer — the
+    ``SPARKDL_SERVE_KV_POOL_MB`` → block-count conversion, derived from
+    :func:`models.llama.paged_pool_spec` (the allocation's own source
+    of truth; no parameter compute, no allocation). With ``kv_dtype``
+    the count covers the quantized K/V codes PLUS each block's slice of
+    the ``kv_scale`` planes (3-D leaves) — the scale overhead is billed
+    against the same budget, so an int8 pool's ≥2× block gain is
+    honest."""
+    shapes = L.paged_pool_spec(model, 1, int(block_size), kv_dtype)
     return sum(int(np.prod(s.shape)) * s.dtype.itemsize
-               for s in _jax.tree_util.tree_leaves(shapes)
-               if len(getattr(s, "shape", ())) == 4)
+               for s in jax.tree_util.tree_leaves(shapes)
+               if len(getattr(s, "shape", ())) in (3, 4))
 
 
 class PagedLlamaSlotBackend(LlamaSlotBackend):
@@ -495,16 +528,23 @@ class PagedLlamaSlotBackend(LlamaSlotBackend):
                  kv_pool_mb: float | None = None,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, seed: int = 0,
-                 prefix_cache_bytes: int | None = None):
+                 prefix_cache_bytes: int | None = None,
+                 kv_dtype: str | None = None,
+                 weight_dtype: str | None = None):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         if max_len < 2:
             raise ValueError(f"max_len must be >= 2, got {max_len}")
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if kv_dtype is not None:
+            L.kv_quant_spec(kv_dtype)  # raises loudly on unknown/absent
+        self.kv_dtype = kv_dtype
         self.model = model
         self.params = variables["params"] if "params" in variables \
             else variables
+        _weight_quantize(self, weight_dtype)
+        model = self.model
         self.num_slots = int(num_slots)
         self.block_size = int(block_size)
         self.max_blocks = -(-int(max_len) // self.block_size)
@@ -513,6 +553,12 @@ class PagedLlamaSlotBackend(LlamaSlotBackend):
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self.top_p = float(top_p)
+        from ..ops import paged_flash_decode as pfd
+        reason = pfd.support_reason(self.block_size, kv_dtype=kv_dtype)
+        if reason is not None:
+            log.info("paged flash-decode kernel stands down for this "
+                     "config (%s); decode steps use the dense gather "
+                     "view", reason)
         if pool_blocks is None and kv_pool_mb is not None:
             # PER-DEVICE budget → block count: on the single-device
             # backend a block's device cost is its full K/V bytes; the
@@ -534,6 +580,25 @@ class PagedLlamaSlotBackend(LlamaSlotBackend):
             radix=budget > 0,
             on_table=self._set_table, copy_block=self._copy_block)
         self.pool_blocks = self.mgr.pool_blocks
+        # Observability (ISSUE 18): pool_stats() and the /serving
+        # inspector carry the kv storage mode, the per-block byte cost
+        # (scale plane included), the f32 cost it displaces and the
+        # resulting effective block count — at equal kv_pool_mb an int8
+        # pool's blocks_total is the ≥2× gain the acceptance pins.
+        per_blk = pool_bytes_per_block(model, self.block_size, kv_dtype)
+        shapes = L.paged_pool_spec(model, 1, self.block_size, kv_dtype)
+        scale_per_blk = sum(
+            int(np.prod(s.shape)) * s.dtype.itemsize
+            for s in jax.tree_util.tree_leaves(shapes)
+            if len(getattr(s, "shape", ())) == 3)
+        self.mgr.info = {
+            "kv_dtype": kv_dtype or "float",
+            "kv_block_bytes": per_blk,
+            "kv_block_bytes_f32": pool_bytes_per_block(
+                model, self.block_size),
+            "kv_scale_bytes_per_block": scale_per_blk,
+            "effective_blocks": self.pool_blocks,
+        }
         self.cache = self._make_pool(model)
         self.allocator = self.mgr.allocator
         self.radix = self.mgr.radix
@@ -547,12 +612,17 @@ class PagedLlamaSlotBackend(LlamaSlotBackend):
         self._warned_commit = False
 
     def _pool_block_device_bytes(self, model) -> int:
-        """Per-DEVICE bytes one pool block costs (see ``__init__``)."""
-        return pool_bytes_per_block(model, self.block_size)
+        """Per-DEVICE bytes one pool block costs (see ``__init__``) —
+        quant-aware: int8/fp8 codes + the block's scale-plane slice,
+        so the same ``kv_pool_mb`` budget honestly buys the extra
+        blocks."""
+        return pool_bytes_per_block(model, self.block_size,
+                                    self.kv_dtype)
 
     def _make_pool(self, model):
         """Pool-allocation hook (see ``LlamaSlotBackend._make_cache``)."""
-        return L.init_paged_pool(model, self.pool_blocks, self.block_size)
+        return L.init_paged_pool(model, self.pool_blocks, self.block_size,
+                                 kv_quant=self.kv_dtype)
 
     # -- allocation plumbing (policy lives in PagedBlockManager) ----------
     def _set_table(self, slot: int, idx: int, block: int) -> None:
@@ -858,10 +928,22 @@ class TensorParallelPagedLlamaSlotBackend(PagedLlamaSlotBackend):
         _tp_finish(self)
 
     def _pool_block_device_bytes(self, model) -> int:
-        return max(1, pool_bytes_per_block(model, self.block_size)
+        return max(1, pool_bytes_per_block(model, self.block_size,
+                                           self.kv_dtype)
                    // self.tp_degree)
 
     def _make_pool(self, model):
+        scale_sharding = None
+        if self.kv_dtype is not None:
+            # the kv_scale planes [pool, Hkv, 2] shard over the same
+            # head axis as the codes they scale — each device holds its
+            # heads' scales, and head_sharded_kernel feeds the kernel
+            # matching shards.
+            from jax.sharding import NamedSharding, PartitionSpec
+            scale_sharding = NamedSharding(
+                self.mesh, PartitionSpec(None, self.layout.axis, None))
         return L.init_paged_pool(model, self.pool_blocks, self.block_size,
                                  kv_sharding=self._kv_sharding,
-                                 scalar_sharding=self._replicated)
+                                 scalar_sharding=self._replicated,
+                                 kv_quant=self.kv_dtype,
+                                 scale_sharding=scale_sharding)

@@ -151,6 +151,18 @@ SPEC_K_ENV = "SPARKDL_SERVE_SPEC_K"
 # topology-aware placement gives each gang rank a disjoint device
 # group (SPARKDL_TP_DEVICE_OFFSET / per-rank visibility).
 TP_ENV = "SPARKDL_SERVE_TP"
+# ISSUE 18 — quantized serving. KV_DTYPE selects the paged pool's K/V
+# storage ("int8" / "fp8"): codes + a per-block [P, Hkv, 2] scale
+# plane, dequantized inside the paged flash-decode kernel — no
+# dequantized cache copy ever lands in HBM. Only meaningful with the
+# paged backend (SPARKDL_SERVE_BLOCK_SIZE > 0); setting it without
+# paging raises — a quantization request silently served at f32 is a
+# 4x memory surprise. WEIGHT_DTYPE ("int8") quantizes the Megatron-
+# sharded projection matmuls (absmax per-output-channel scales,
+# dequant folded after the int8 dot); works on paged and un-paged,
+# tp or single-device backends alike.
+KV_DTYPE_ENV = "SPARKDL_SERVE_KV_DTYPE"
+WEIGHT_DTYPE_ENV = "SPARKDL_SERVE_WEIGHT_DTYPE"
 
 _DEFAULT_SLOTS = 8
 _DEFAULT_MAX_LEN = 2048
@@ -712,6 +724,8 @@ class GenerationEngine:
                    pool_blocks: int | None = None,
                    kv_pool_mb: float | None = None,
                    tp: int | None = None, mesh=None,
+                   kv_dtype: str | None = None,
+                   weight_dtype: str | None = None,
                    **kw) -> "GenerationEngine":
         """Build an engine over :class:`serving.backend.LlamaSlotBackend`
         (the jax import happens here, not at module import).
@@ -732,7 +746,13 @@ class GenerationEngine:
         visible devices at ``SPARKDL_TP_DEVICE_OFFSET``). tp <= 1 is
         exactly the single-device path — same classes, same compiled
         signatures. Paged + tp makes ``kv_pool_mb`` a PER-DEVICE
-        budget (each device holds 1/tp of every block)."""
+        budget (each device holds 1/tp of every block).
+
+        ``kv_dtype`` ("int8"/"fp8", or ``SPARKDL_SERVE_KV_DTYPE``)
+        block-quantizes the paged K/V pool (ISSUE 18; paged only —
+        raises otherwise); ``weight_dtype`` ("int8", or
+        ``SPARKDL_SERVE_WEIGHT_DTYPE``) quantizes the projection
+        weights on any backend."""
         num_slots = num_slots if num_slots is not None \
             else _env_num(SLOTS_ENV, _DEFAULT_SLOTS)
         max_len = max_len if max_len is not None \
@@ -788,7 +808,22 @@ class GenerationEngine:
                     f"{extent} device(s)")
         pbytes = None if prefix_cache_mb is None \
             else int(prefix_cache_mb * 2 ** 20)
+        if kv_dtype is None:
+            kv_dtype = os.environ.get(KV_DTYPE_ENV) or None
+        if weight_dtype is None:
+            weight_dtype = os.environ.get(WEIGHT_DTYPE_ENV) or None
+        if kv_dtype and not (block_size and block_size > 0):
+            # A quantized-KV request silently served from the un-paged
+            # f32 cache is a 4x memory surprise AND a wrong-bench — the
+            # malformed-knob posture raises instead.
+            raise ValueError(
+                f"{KV_DTYPE_ENV}={kv_dtype!r} requires the paged "
+                f"backend ({BLOCK_SIZE_ENV} > 0); the un-paged cache "
+                "has no quantized mode")
+        # tp_kw's truthiness SELECTS the TensorParallel class — keep it
+        # tp-only and carry weight_dtype in its own dict.
         tp_kw = {"tp": int(tp), "mesh": mesh} if tp and tp > 1 else {}
+        wq_kw = {"weight_dtype": weight_dtype} if weight_dtype else {}
         if block_size and block_size > 0:
             from .backend import (PagedLlamaSlotBackend,
                                   TensorParallelPagedLlamaSlotBackend)
@@ -799,9 +834,10 @@ class GenerationEngine:
             backend = klass(
                 model, variables, num_slots, max_len,
                 block_size=int(block_size), pool_blocks=pool_blocks,
-                kv_pool_mb=kv_pool_mb, temperature=temperature,
+                kv_pool_mb=kv_pool_mb, kv_dtype=kv_dtype,
+                temperature=temperature,
                 top_k=top_k, top_p=top_p, seed=seed,
-                prefix_cache_bytes=pbytes, **tp_kw)
+                prefix_cache_bytes=pbytes, **tp_kw, **wq_kw)
         else:
             from .backend import (LlamaSlotBackend,
                                   TensorParallelLlamaSlotBackend)
@@ -810,7 +846,7 @@ class GenerationEngine:
             backend = klass(
                 model, variables, num_slots, max_len,
                 temperature=temperature, top_k=top_k, top_p=top_p,
-                seed=seed, prefix_cache_bytes=pbytes, **tp_kw)
+                seed=seed, prefix_cache_bytes=pbytes, **tp_kw, **wq_kw)
         return cls(backend, eos_id=eos_id, **kw)
 
     # -- telemetry helpers ------------------------------------------------
@@ -1764,6 +1800,12 @@ class GenerationEngine:
                      ps.get("blocks_free", 0))
         self._metric("gauge", "serving_kv_blocks_shared",
                      ps.get("blocks_shared", 0))
+        # ISSUE 18 — how many pool blocks the configured kv dtype
+        # bought at this budget (pool_blocks incl. the trash block;
+        # named so a dashboard can overlay int8 vs f32 runs at equal
+        # SPARKDL_SERVE_KV_POOL_MB).
+        self._metric("gauge", "kv_pool_effective_blocks",
+                     ps.get("effective_blocks", ps.get("blocks_total", 0)))
         drain = getattr(self.backend, "drain_alloc_samples", None)
         if drain is not None:
             for dt in drain():

@@ -256,6 +256,12 @@ class PagedBlockManager:
                                              range(self.num_slots)]
         self._on_table = on_table or (lambda slot, idx, block: None)
         self._copy_block = copy_block or (lambda src, dst: None)
+        # Static pool facts the owning backend publishes through
+        # pool_stats() (ISSUE 18 observability: kv dtype, per-block
+        # byte cost incl. scale overhead, effective block count). The
+        # manager itself is jax-free and dtype-agnostic — it only
+        # carries the dict.
+        self.info: dict = {}
 
     # -- capacity ---------------------------------------------------------
     def _reclaim(self, n: int) -> int:
@@ -402,6 +408,7 @@ class PagedBlockManager:
         st = self.allocator.stats()
         if self.radix is not None:
             st["radix_blocks"] = len(self.radix)
+        st.update(self.info)
         return st
 
     def prefix_stats(self) -> dict | None:

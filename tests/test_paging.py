@@ -641,3 +641,84 @@ class TestPagedEngineOnCpu:
         eng_bl.run_until_idle()
         assert hb2.result(1) == refs[0]
         assert eng_bl.backend.allocator.used_count() == 0
+
+    def test_quant_radix_graft_and_cow_exact(self):
+        """ISSUE 18 — the radix graft and copy-on-write stay EXACT on a
+        quantized pool: an int8 engine WITH sharing emits the identical
+        streams as an int8 engine WITHOUT (private blocks only), a CoW
+        copy duplicates codes AND the per-block scale rows
+        bit-identically, and pool_stats carries the quant observables
+        (>= 2x blocks at equal MB is the engine-level acceptance)."""
+        import jax
+
+        from sparkdl_tpu.models import llama as L
+
+        cfg = L.LlamaConfig.tiny()
+        model = L.LlamaModel(cfg)
+        variables = model.init(jax.random.PRNGKey(0),
+                               np.zeros((1, 4), np.int32))
+        rng = np.random.RandomState(11)
+        max_len, new = 64, 6
+        head = rng.randint(0, cfg.vocab_size, 16).tolist()
+        pa = head + rng.randint(0, cfg.vocab_size, 3).tolist()
+        pb = head + rng.randint(0, cfg.vocab_size, 6).tolist()
+
+        def make(prefix_mb=None):
+            return GenerationEngine.from_model(
+                model, variables, num_slots=2, max_len=max_len,
+                prefill_chunk=8, block_size=8, prefill_budget=16,
+                kv_dtype="int8", prefix_cache_mb=prefix_mb)
+
+        # reference: int8 engine, radix OFF — every block private
+        want = []
+        for p in (pa, pb):
+            e = make(prefix_mb=0)
+            h = e.submit(p, max_new_tokens=new)
+            e.run_until_idle()
+            want.append(h.result(1))
+
+        # radix ON, staggered so pb grafts pa's resident head blocks
+        eng = make()
+        ha = eng.submit(pa, max_new_tokens=new)
+        eng.step()
+        eng.step()
+        assert ha.state == "running"
+        hb = eng.submit(pb, max_new_tokens=new)
+        eng.step()
+        be = eng.backend
+        sa, sb = ha.slot, hb.slot
+        assert (be.tables[sa][:2] == be.tables[sb][:2]).all()
+        shared = int(be.tables[sb][0])
+        assert be.allocator.is_shared(shared)
+
+        # CoW through the quantized pool: codes (4-D) AND scale rows
+        # (3-D plane) both copied bit-identically
+        assert be.mgr._cow(sb, 0) is True
+        fresh = int(be.tables[sb][0])
+        assert fresh != shared
+        saw_scale = False
+        for leaf in jax.tree_util.tree_leaves(be.cache):
+            nd = getattr(leaf, "ndim", 0)
+            if nd in (3, 4):
+                assert np.array_equal(np.asarray(leaf[shared]),
+                                      np.asarray(leaf[fresh]))
+                saw_scale |= nd == 3
+        assert saw_scale, "no kv_scale plane in the quantized pool"
+        eng.run_until_idle()
+        # EXACTNESS: graft + CoW changed nothing vs the private runs
+        assert ha.result(1) == want[0]
+        assert hb.result(1) == want[1]
+
+        # quant observables + the equal-MB capacity acceptance
+        st = be.pool_stats()
+        assert st["kv_dtype"] == "int8"
+        assert st["kv_block_bytes"] < st["kv_block_bytes_f32"]
+        assert st["kv_scale_bytes_per_block"] > 0
+        b_f32 = GenerationEngine.from_model(
+            model, variables, num_slots=2, max_len=max_len,
+            block_size=8, kv_pool_mb=0.5).backend.pool_stats()
+        b_q = GenerationEngine.from_model(
+            model, variables, num_slots=2, max_len=max_len,
+            block_size=8, kv_pool_mb=0.5,
+            kv_dtype="int8").backend.pool_stats()
+        assert b_q["blocks_total"] >= 2 * b_f32["blocks_total"]

@@ -437,6 +437,61 @@ class TestTpEngineOnCpu:
         engd.run_until_idle()
         assert [h.result(1) for h in hs] == refs
 
+    def test_tp_int8_token_parity(self, monkeypatch):
+        """ISSUE 18: the int8-quantized paged engine (int8 KV codes +
+        scale plane + int8 projection weights) is degree-invariant —
+        tp ∈ {1, 2} and kernel-off vs kernel-forced all emit the SAME
+        greedy streams. int8 may legitimately differ from the f32
+        static reference; it may NOT differ across shardings of the
+        same quantized program (the scale plane sharding with heads and
+        the absmax channel scales sharding with their projections are
+        exactly what this pins). Odd slot count keeps jit signatures
+        private (the shape-keyed cache rule from the kernel test)."""
+        import jax
+
+        cfg, model, variables = _tiny_model()
+        rng = np.random.RandomState(23)
+        new = 8
+        prompts = [rng.randint(0, cfg.vocab_size, n).tolist()
+                   for n in (4, 9, 13)]
+
+        def run(tp, kernel):
+            # one knob per backend family: paged single-device vs the
+            # shard_map head-sharded dispatch under tp
+            monkeypatch.setenv("SPARKDL_SERVE_PAGED_KERNEL", kernel)
+            monkeypatch.setenv("SPARKDL_SERVE_TP_KERNEL", kernel)
+            eng = GenerationEngine.from_model(
+                model, variables, num_slots=3, max_len=48, block_size=8,
+                prefill_chunk=8, kv_dtype="int8", weight_dtype="int8",
+                tp=tp)
+            hs = [eng.submit(p, max_new_tokens=new) for p in prompts]
+            eng.run_until_idle()
+            return [h.result(1) for h in hs], eng
+
+        base, _ = run(1, "0")
+        assert all(len(s) == new for s in base)
+        for tp, kernel in ((1, "1"), (2, "0"), (2, "1")):
+            got, eng = run(tp, kernel)
+            assert got == base, (tp, kernel)
+        # the last engine is tp=2 kernel-forced: codes halve per device
+        # and the scale plane shards over its head axis alongside them
+        # (kv_pool_device_bytes counts BOTH — codes + the 3-dim plane)
+        import jax.tree_util as jtu
+        plane_bytes = sum(
+            x.size * x.dtype.itemsize
+            for x in jtu.tree_leaves(eng.backend.cache)
+            if getattr(x, "ndim", 0) == 3)
+        assert plane_bytes > 0
+        assert eng.kv_pool_device_bytes * 2 == \
+            _global_kv_bytes(eng.backend.cache) + plane_bytes
+        plane = eng.backend.cache["layer_0"]["attn"]["kv_scale"]
+        # jax normalizes away the trailing None of P(None, 'tp', None)
+        assert plane.sharding.spec == \
+            jax.sharding.PartitionSpec(None, "tp")
+        ps = eng.backend.pool_stats()
+        assert ps["kv_dtype"] == "int8"
+        assert ps["kv_scale_bytes_per_block"] > 0
+
     def test_tp_gauges_zero_registration_when_plane_off(self):
         from sparkdl_tpu.runner import telemetry
         from sparkdl_tpu.serving import StubBackend
