@@ -1258,6 +1258,17 @@ def _serve_headline(serve: dict) -> dict:
                       "serve_spec_mean_accept_len")):
         if spec.get(src) is not None:
             out[dst] = spec[src]
+    # ISSUE 19: survivability headline — recovery latency for one
+    # injected failover and the exactly-once token-identity gate (a
+    # float, 1.0 = every faulted stream matched the clean run, so
+    # bench_trend's numeric gating covers it; _s suffix makes
+    # recovery auto lower-is-better). Stub leg, rides healthy AND
+    # backend_unavailable records.
+    surv = serve.get("survivability") or {}
+    if surv.get("recovery_s") is not None:
+        out["serve_recovery_s"] = surv["recovery_s"]
+    if surv.get("token_identical") is not None:
+        out["serve_failover_token_identical"] = surv["token_identical"]
     # ISSUE 14: tensor-parallel headline — greedy identity across the
     # tp degrees, per-device KV pool bytes (the 1/tp shrink), and
     # zero-re-trace evidence, from the 8-virtual-device subprocess leg

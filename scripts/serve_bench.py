@@ -1213,6 +1213,111 @@ def run_tp_comparison(n_requests: int = 24,
     return {"mode": "tp", "error": "no JSON in tp worker output"}
 
 
+# ---------------------------------------------------------------------------
+# ISSUE 19 survivability leg (stub, jax-free — rides BOTH records)
+# ---------------------------------------------------------------------------
+
+def run_survivability_comparison(n_requests: int = 24,
+                                 num_slots: int = 4,
+                                 concurrency: int = 8,
+                                 step_s: float = 0.002) -> dict:
+    """The serving-survivability cost model: the SAME closed-loop
+    workload driven clean and with ONE injected ``cache_lost`` failover
+    mid-decode (seeded chaos plan, fires once). Reports tokens/s and
+    TTFT p99 for both runs, the failover recovery latency (fault to
+    first resumed token, off the engine's own ledger), and whether the
+    faulted run's greedy streams were token-identical to the clean
+    run's — the exactly-once resume observable ``bench_trend`` gates
+    (``serve_recovery_s`` lower-is-better, and
+    ``serve_failover_token_identical`` must stay 1.0)."""
+    from sparkdl_tpu.runner import chaos, telemetry
+    from sparkdl_tpu.runner.chaos import Fault, FaultPlan
+    from sparkdl_tpu.runner.telemetry import histogram_quantile
+    from sparkdl_tpu.serving import GenerationEngine, StubBackend
+
+    vocab = 997  # prime: the stub fold-chain stream is a real oracle
+    rng = np.random.RandomState(5)
+    workload = [(rng.randint(1, vocab,
+                             size=int(rng.choice((4, 8, 16)))).tolist(),
+                 int(rng.choice((8, 16)))) for _ in range(n_requests)]
+
+    def drive(plan):
+        chaos.uninstall()
+        telemetry.reset()
+        telemetry.start()
+        # fixed backoff dominates recovery_s so the gated number is a
+        # stable ~50ms+resume figure, not sub-millisecond timer noise
+        eng = GenerationEngine(
+            StubBackend(num_slots, 256, vocab_size=vocab,
+                        step_s=step_s), retries=1,
+            failover_backoff_s=0.05)
+        if plan is not None:
+            chaos.install(plan)
+        tokens_by_idx: dict = {}
+        errors: list = []
+
+        def client(idx_chunk):
+            try:
+                for i in idx_chunk:
+                    prompt, new = workload[i]
+                    h = eng.submit(prompt, max_new_tokens=new)
+                    tokens_by_idx[i] = h.result(timeout=120)
+            except Exception as e:  # noqa: BLE001 — recorded below
+                errors.append(f"{type(e).__name__}: {e}")
+
+        chunks = [list(range(len(workload)))[i::concurrency]
+                  for i in range(concurrency)]
+        threads = [threading.Thread(target=client, args=(c,),
+                                    daemon=True) for c in chunks if c]
+        eng.start()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        wall = time.perf_counter() - t0
+        eng.stop(drain=True, timeout=30)
+        ttft = telemetry.registry().histogram("serving_ttft_s").snapshot()
+        snap = eng.snapshot()
+        try:
+            chaos.uninstall()
+        finally:
+            telemetry.reset()
+        total = sum(len(v) for v in tokens_by_idx.values())
+        leg = {"completed": snap["completed"],
+               "tokens": total,
+               "wall_s": round(wall, 4),
+               "tokens_s": round(total / wall, 2) if wall > 0 else None,
+               "ttft_p99_s": histogram_quantile(ttft, 0.99),
+               "failovers": snap["failovers"],
+               "failover_resumed": snap["failover_resumed"],
+               "recovery_s": snap["failover"].get("last_recovery_s")}
+        if errors:
+            leg["errors"] = errors[:5]
+        return leg, tokens_by_idx
+
+    clean, clean_toks = drive(None)
+    # seeded prob + once: fires exactly one cache_lost on SOME decode
+    # call a little into the run — deterministic for a given seed
+    faulted, fault_toks = drive(FaultPlan(
+        [Fault("serve_decode", "cache_lost", prob=0.2)], seed=9))
+    identical = (set(clean_toks) == set(fault_toks) and all(
+        clean_toks[i] == fault_toks[i] for i in clean_toks))
+    return {
+        "requests": n_requests, "concurrency": concurrency,
+        "num_slots": num_slots, "step_s": step_s,
+        "clean": clean, "faulted": faulted,
+        "failovers": faulted["failovers"],
+        "recovery_s": faulted["recovery_s"],
+        # float on purpose: bench_trend auto-gates numeric scalars and
+        # skips bools — 1.0 means every stream matched the clean run
+        "token_identical": 1.0 if identical else 0.0,
+        "tokens_s_ratio": round(
+            faulted["tokens_s"] / clean["tokens_s"], 4)
+        if clean["tokens_s"] and faulted["tokens_s"] else None,
+    }
+
+
 def run_stub_scheduler_comparison(n_requests: int = 96,
                                   num_slots: int = 8,
                                   step_s: float = 0.002,
@@ -1266,6 +1371,17 @@ def run(mode: str = "llama", rows: int | None = None) -> dict:
                     n_requests=min(48, max(16, n)))
         except Exception as e:  # noqa: BLE001 — the main legs stand
             rec["spec_error"] = f"{type(e).__name__}: {e}"[:300]
+    # ISSUE 19 survivability leg: one injected failover vs clean on the
+    # stub (jax-free, seconds of wall) — recovery latency and the
+    # exactly-once token-identity gate ride BOTH the healthy llama
+    # record and the backend_unavailable stub record, so an outage
+    # never blinds the survivability trend.
+    if not os.environ.get("BENCH_SKIP_SURVIVABILITY"):
+        try:
+            rec["survivability"] = run_survivability_comparison(
+                n_requests=min(24, max(12, n)))
+        except Exception as e:  # noqa: BLE001 — the main legs stand
+            rec["survivability_error"] = f"{type(e).__name__}: {e}"[:300]
     # ISSUE 15 paged-kernel leg (real model, llama records only — the
     # stub record's kernel evidence is the churn sub-leg above): two
     # subprocesses pin kernel-on vs gather-view token identity + the

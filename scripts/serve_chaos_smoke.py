@@ -1,0 +1,324 @@
+#!/usr/bin/env python
+"""Serving survivability chaos smoke (ISSUE 19 tentpole evidence).
+
+Four backend shapes — Stub/Llama x unpaged/paged, all on CPU — each
+driven through:
+
+1. **Clean run** — ground-truth greedy streams; every streamed token is
+   ledgered through ``stream_cb``.
+2. **Chaos run** — ``cache_lost`` injected at ``serve_decode`` AND
+   ``serve_alloc`` (one fire each): the engine must fail over (host-side
+   snapshot, backend rebuild, preemption-resume re-admission) and finish
+   **token-identical** to the clean run with **zero duplicated or lost
+   streamed tokens** — the delivery-cursor audit
+   (``streamed == request.tokens`` and ``delivered == len(tokens)``).
+
+Engine-layer legs (backend-independent semantics, run on the stub):
+
+3. **Budget counterfactual** — ``cache_lost`` on EVERY prefill (no
+   request ever progresses): the failover budget must exhaust, the
+   engine fails CLOSED, and every pending request carries an
+   ``EngineStopped`` naming ``SPARKDL_SERVE_FAILOVER_BUDGET``;
+   ``classify_exception`` agrees it is retryable for the outer
+   supervisor (a fresh engine can serve the same requests).
+4. **Drain + resume** — ``drain()`` mid-run returns live snapshots that
+   resume token-identically on a FRESH engine, nothing re-emitted.
+5. **Quarantine ledger** — a poisoned prompt that loses the slot cache
+   on every admission is quarantined individually while the rest of the
+   fleet completes, and the count agrees across engine stats, telemetry
+   counters, and the flight-recorder dead-letter events
+   (``serve_request_quarantined``).
+
+Prints one JSON line and exits 0 on success.
+
+Run: ``JAX_PLATFORMS=cpu python scripts/serve_chaos_smoke.py``
+(``SERVE_CHAOS_SKIP_LLAMA=1`` limits to the stub shapes.)
+"""
+
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N_REQ = int(os.environ.get("SERVE_CHAOS_REQUESTS", "6"))
+VOCAB = 997  # prime vocab: the stub's fold-chain stream is a real oracle
+
+
+def _workload(rng, vocab: int, n: int, max_new=(4, 6, 8)):
+    return [(rng.randint(1, vocab, size=int(rng.choice((2, 4, 7))))
+             .tolist(), int(rng.choice(max_new))) for _ in range(n)]
+
+
+def _run(make_engine, workload, plan=None):
+    """One inline leg: submit everything with a stream ledger, drive to
+    idle under ``plan`` (installed for the duration), return the engine
+    and its requests + per-request streamed-token lists."""
+    from sparkdl_tpu.runner import chaos
+
+    chaos.uninstall()
+    eng = make_engine()
+    streams = {}
+
+    def cb(req, tok):
+        streams.setdefault(req.id, []).append(tok)
+
+    if plan is not None:
+        chaos.install(plan)
+    try:
+        reqs = [eng.submit(p, max_new_tokens=n, stream_cb=cb)
+                for p, n in workload]
+        eng.run_until_idle()
+    finally:
+        chaos.uninstall()
+    return eng, reqs, streams
+
+
+def _audit_exactly_once(reqs, streams):
+    """The delivery-cursor audit: the streamed ledger must equal the
+    final token list (no dup, no gap, in order) and the engine's cursor
+    must sit at the emitted frontier."""
+    for r in reqs:
+        if streams.get(r.id, []) != r.tokens:
+            return False, (f"request {r.id}: streamed "
+                           f"{streams.get(r.id)} != tokens {r.tokens}")
+        if r.delivered != len(r.tokens):
+            return False, (f"request {r.id}: delivered={r.delivered} "
+                           f"!= {len(r.tokens)} tokens")
+    return True, None
+
+
+def chaos_identity_leg(name, make_engine, workload) -> dict:
+    """Legs 1+2 for one backend shape: clean ground truth, then the
+    same workload under injected cache_lost at serve_decode +
+    serve_alloc, asserting failover happened and was invisible in the
+    output stream."""
+    from sparkdl_tpu.runner.chaos import Fault, FaultPlan
+
+    clean_eng, clean, cstreams = _run(make_engine, workload)
+    ok, why = _audit_exactly_once(clean, cstreams)
+    assert ok, f"[{name}] clean-run stream ledger broken: {why}"
+    assert all(r.state == "done" for r in clean), \
+        f"[{name}] clean run did not complete"
+
+    plan = FaultPlan([Fault("serve_decode", "cache_lost", prob=1.0),
+                      Fault("serve_alloc", "cache_lost", prob=1.0)],
+                     seed=3)
+    eng, reqs, streams = _run(make_engine, workload, plan=plan)
+    assert all(r.state == "done" for r in reqs), \
+        f"[{name}] chaos run did not complete: " \
+        f"{[(r.id, r.state, str(r.error)[:80]) for r in reqs]}"
+    failovers = eng.stats["failovers"]
+    assert failovers >= 1, f"[{name}] no failover fired"
+    assert eng._failover_info["state"] == "recovered"
+    identical = all(r.tokens == c.tokens for r, c in zip(reqs, clean))
+    assert identical, f"[{name}] chaos run not token-identical: " + str(
+        [(r.tokens, c.tokens) for r, c in zip(reqs, clean)
+         if r.tokens != c.tokens][:2])
+    ok, why = _audit_exactly_once(reqs, streams)
+    assert ok, f"[{name}] exactly-once audit failed: {why}"
+    return {"failovers": failovers,
+            "resumed": eng.stats["failover_resumed"],
+            "requests": len(reqs),
+            "token_identical": identical}
+
+
+def budget_counterfactual_leg() -> dict:
+    """Leg 3: with cache_lost on every prefill nothing ever progresses,
+    so the engine must exhaust its failover budget and fail CLOSED with
+    a classified error — never loop forever."""
+    from sparkdl_tpu.runner.chaos import Fault, FaultPlan
+    from sparkdl_tpu.runner.failures import classify_exception
+    from sparkdl_tpu.serving import (EngineStopped, GenerationEngine,
+                                     StubBackend)
+
+    from sparkdl_tpu.runner import chaos
+
+    budget = 2
+    plan = FaultPlan([Fault("serve_prefill", "cache_lost", prob=1.0,
+                            once=False)])
+    chaos.uninstall()
+    eng = GenerationEngine(StubBackend(2, 64, vocab_size=VOCAB),
+                           retries=1, failover_budget=budget)
+    chaos.install(plan)
+    terminal = None
+    try:
+        reqs = [eng.submit(p, max_new_tokens=n)
+                for p, n in [([5], 4), ([9], 4)]]
+        try:
+            eng.run_until_idle()
+        except Exception as e:  # noqa: BLE001 — the fail-closed raise
+            terminal = e
+    finally:
+        chaos.uninstall()
+    # fail CLOSED means the driver sees the terminal error, not a hang
+    assert terminal is not None, "engine kept stepping past the budget"
+    assert eng._failover_info["state"] == "exhausted", eng._failover_info
+    assert eng.stats["failovers"] == budget
+    errs = [r.error for r in reqs]
+    assert all(r.state == "failed" for r in reqs)
+    assert all(isinstance(e, EngineStopped) for e in errs), errs
+    assert all("failover budget exhausted" in str(e) for e in errs)
+    assert all(f"SPARKDL_SERVE_FAILOVER_BUDGET={budget}" in str(e)
+               for e in errs)
+    verdicts = {classify_exception(e) for e in errs}
+    assert verdicts == {"retryable"}, verdicts
+    return {"budget": budget, "failovers": eng.stats["failovers"],
+            "error_verdict": "retryable"}
+
+
+def drain_resume_leg() -> dict:
+    """Leg 4: drain a threaded engine mid-run; the snapshots must resume
+    on a FRESH engine and finish token-identical to an uninterrupted
+    run (greedy determinism + the exactly-once cursor)."""
+    import time
+
+    from sparkdl_tpu.serving import GenerationEngine, StubBackend
+
+    mk = lambda: GenerationEngine(  # noqa: E731
+        StubBackend(2, 128, vocab_size=VOCAB, step_s=0.004), retries=1)
+    workload = [([11 * (i + 1)], 12) for i in range(3)]
+    _, clean, _ = _run(mk, workload)
+
+    eng = mk().start()
+    reqs = [eng.submit(p, max_new_tokens=n) for p, n in workload]
+    deadline = time.time() + 10
+    while time.time() < deadline and \
+            not any(len(r.tokens) >= 4 for r in reqs):
+        time.sleep(0.005)
+    snaps = eng.drain(timeout=10)
+    assert snaps, "drain() mid-run returned no live snapshots"
+    fresh = mk()
+    for r in snaps:
+        fresh.resume(r)
+    fresh.run_until_idle()
+    assert all(r.state == "done" for r in reqs)
+    identical = all(r.tokens == c.tokens for r, c in zip(reqs, clean))
+    assert identical, [(r.tokens, c.tokens)
+                       for r, c in zip(reqs, clean)]
+    assert all(r.delivered == len(r.tokens) for r in reqs)
+    return {"drained": len(snaps), "resumed_identical": identical}
+
+
+def quarantine_ledger_leg() -> dict:
+    """Leg 5: one poisoned prompt rides every failover without progress
+    and is quarantined individually; the fleet completes, and the
+    quarantine count agrees across engine stats, telemetry counters,
+    and the flight-recorder dead-letter events."""
+    from sparkdl_tpu.runner import events, telemetry
+    from sparkdl_tpu.runner.chaos import InjectedCacheLost
+    from sparkdl_tpu.serving import (GenerationEngine, RequestQuarantined,
+                                     StubBackend)
+
+    class PoisonStub(StubBackend):
+        # dies at commit — AFTER co-resident chunk prefills emitted, so
+        # the fleet progresses every cycle (engine streak resets) while
+        # the poison request personally never gains a token
+        def finish_prefill(self, slot, prompt, last_tok, aligned_len,
+                           commit=True):
+            if list(prompt)[:1] == [99]:
+                raise InjectedCacheLost(
+                    "poisoned request lost the slot cache")
+            return super().finish_prefill(slot, prompt, last_tok,
+                                          aligned_len, commit=commit)
+
+    mk = lambda: GenerationEngine(  # noqa: E731
+        PoisonStub(2, 64, vocab_size=VOCAB), retries=1,
+        failover_budget=2, prefill_chunk=8, prefill_budget=16)
+    # one short innocent (frees a slot so the poison admits) and one
+    # LONG one that stays live across every poison failover — its
+    # per-cycle progress is what keeps the engine streak at 1 while the
+    # poison's personal count walks to the quarantine line
+    good_load = [([7], 4), ([13], 30)]
+    _, clean, _ = _run(mk, good_load)
+
+    telemetry.reset()
+    telemetry.start()
+    rec = events.reset(ring_size=8192)
+    try:
+        eng, reqs, streams = _run(mk, good_load + [([99, 1], 5)])
+        good, bad = reqs[:2], reqs[2]
+        assert all(r.state == "done" for r in good)
+        assert all(r.tokens == c.tokens
+                   for r, c in zip(good, clean)), "fleet stream moved"
+        ok, why = _audit_exactly_once(good, streams)
+        assert ok, why
+        assert bad.state == "failed"
+        assert isinstance(bad.error, RequestQuarantined), bad.error
+        counters = telemetry.registry().snapshot()["counters"]
+        dead_letters = [e for e in rec.tail()
+                        if e["name"] == "serve_request_quarantined"]
+        ledger = {
+            "stats_quarantined": eng.stats["quarantined"],
+            "stats_failover_quarantined":
+                eng.stats["failover_quarantined"],
+            "info_quarantined_total":
+                eng._failover_info["quarantined_total"],
+            "counter_quarantined":
+                counters.get("serving_requests_quarantined_total", 0),
+            "dead_letter_events": len(dead_letters),
+        }
+        assert set(ledger.values()) == {1}, ledger
+        assert eng._failover_info["state"] == "recovered"
+    finally:
+        telemetry.reset()
+        events.reset()
+    return ledger
+
+
+def main() -> int:
+    import numpy as np
+
+    from sparkdl_tpu.serving import GenerationEngine, StubBackend
+
+    rng = np.random.RandomState(0)
+    out = {"legs": {}}
+
+    stub_load = _workload(rng, VOCAB, N_REQ)
+    shapes = {
+        "stub": lambda: GenerationEngine(
+            StubBackend(2, 64, vocab_size=VOCAB), retries=1),
+        "stub_paged": lambda: GenerationEngine(
+            StubBackend(2, 64, vocab_size=VOCAB, block_size=8,
+                        prefix_cache_bytes=1 << 20), retries=1),
+    }
+    if os.environ.get("SERVE_CHAOS_SKIP_LLAMA", "") != "1":
+        import jax
+
+        from sparkdl_tpu.models import llama as L
+
+        cfg = L.LlamaConfig.tiny()
+        model = L.LlamaModel(cfg)
+        variables = model.init(jax.random.PRNGKey(0),
+                               np.zeros((1, 4), np.int32))
+        llama_load = _workload(rng, cfg.vocab_size, 4, max_new=(3, 5))
+
+        def _llama(block_size=None):
+            return GenerationEngine.from_model(
+                model, variables, num_slots=2, max_len=64,
+                block_size=block_size, temperature=0.0, min_bucket=8,
+                queue_capacity=64, retries=1)
+
+        shapes["llama"] = lambda: _llama()
+        shapes["llama_paged"] = lambda: _llama(block_size=16)
+
+    for name, mk in shapes.items():
+        load = stub_load if name.startswith("stub") else llama_load
+        out["legs"][name] = chaos_identity_leg(name, mk, load)
+
+    out["legs"]["budget_counterfactual"] = budget_counterfactual_leg()
+    out["legs"]["drain_resume"] = drain_resume_leg()
+    out["legs"]["quarantine_ledger"] = quarantine_ledger_leg()
+
+    out["ok"] = (
+        all(v.get("token_identical", True)
+            for v in out["legs"].values())
+        and out["legs"]["drain_resume"]["resumed_identical"])
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,6 +31,16 @@ Sites (where the runner consults the plan):
   (``transformers/streaming.py``; exercises record quarantine)
 - ``dispatch``         — device dispatch of one scoring batch
   (``BatchRunner.run_stream``; exercises the bounded dispatch retry)
+- ``serve_prefill``    — a serving backend's prefill / prefill-chunk call
+  (``serving/backend.py``; exercises prefill retry → quarantine and, for
+  ``cache_lost``, the engine failover supervisor)
+- ``serve_decode``     — a serving backend's decode / verify step
+  (exercises step retry → evict-newest and failover)
+- ``serve_alloc``      — a paged block reservation (``begin_prefill`` /
+  ``ensure_block_for``; exercises exhaustion-as-backpressure vs failover
+  routing)
+- ``serve_commit``     — a prefix-cache / radix commit at prefill end
+  (commit failures must degrade, never kill the request)
 
 Kinds (what happens when a fault fires):
 
@@ -58,6 +68,13 @@ Kinds (what happens when a fault fires):
   ISSUE 16). A relaunch at a *different* world size is a fresh
   allocation and the marker does not apply; deleting the marker models
   recovered capacity (the grow-back probe then succeeds).
+- ``cache_lost`` — raise a serving-fatal ``InjectedCacheLost`` shaped like
+  the donated-slot-cache loss ``serving/backend.py`` converts real jit
+  failures into (``SlotCacheLost``): the slot KV cache is gone, retrying
+  the call cannot help, and the engine must fail over (snapshot live
+  requests, rebuild the backend, re-admit). Serving sites only — this is
+  how the failover path is exercised on CPU, where cache donation is not
+  real.
 
 Triggers are deterministic: ``at_step=N`` fires when the hook's step equals
 N; ``prob=p`` draws from a per-fault ``RandomState`` seeded from
@@ -85,17 +102,20 @@ import sys
 import time
 
 __all__ = ["Fault", "FaultPlan", "InjectedFault", "InjectedPreemption",
-           "InjectedFatal", "SITES", "KINDS", "CHAOS_ENV",
+           "InjectedFatal", "InjectedCacheLost", "SITES", "SERVING_SITES",
+           "KINDS", "CHAOS_ENV",
            "fire", "install", "uninstall", "active_plan",
            "corrupt_latest_checkpoint"]
 
 CHAOS_ENV = "SPARKDL_CHAOS"
 
+SERVING_SITES = ("serve_prefill", "serve_decode", "serve_alloc",
+                 "serve_commit")
 SITES = ("step_start", "checkpoint_save", "batch_fetch", "collective",
          "worker", "decode", "dispatch", "checkpoint_restore",
-         "data_fetch")
+         "data_fetch") + SERVING_SITES
 KINDS = ("preempt", "fatal", "nan", "hang", "sigkill", "corrupt", "poison",
-         "decimate")
+         "decimate", "cache_lost")
 
 
 class InjectedFault(RuntimeError):
@@ -111,6 +131,16 @@ class InjectedPreemption(InjectedFault):
 
 class InjectedFatal(InjectedFault):
     """Fatal: shaped like an INVALID_ARGUMENT program error."""
+
+
+class InjectedCacheLost(InjectedFault):
+    """Serving-fatal: shaped like ``serving.backend.SlotCacheLost`` — a
+    jitted slot call died AFTER consuming its donated KV cache, so the
+    backend's device state is unrecoverable and the engine must fail over
+    rather than retry. Defined here (not in ``serving/``) so the chaos
+    module stays jax-free; the engine routes on the ``serving_fatal``
+    class attribute, exactly as it does for the organic error."""
+    serving_fatal = True
 
 
 # The one announcement string for DELIBERATE fault injection in
@@ -179,6 +209,10 @@ class Fault:
         if self.kind == "corrupt" and self.site != "checkpoint_restore":
             raise ValueError("kind='corrupt' damages on-disk checkpoints — "
                              "use site='checkpoint_restore'")
+        if self.kind == "cache_lost" and self.site not in SERVING_SITES:
+            raise ValueError("kind='cache_lost' models a donated slot-"
+                             "cache loss — use a serving site: "
+                             f"{SERVING_SITES}")
         if self.at_step is None and not (0.0 < self.prob <= 1.0):
             raise ValueError(f"fault needs a trigger: at_step=N or "
                              f"0 < prob <= 1 (got at_step=None, "
@@ -367,6 +401,11 @@ def _execute(f: Fault, site: str, step, batch, path: str | None = None):
     if f.kind == "fatal":
         raise InjectedFatal(
             f"INVALID_ARGUMENT: injected program error ({where})")
+    if f.kind == "cache_lost":
+        raise InjectedCacheLost(
+            f"injected slot-cache loss ({where}): donated KV cache "
+            "consumed by a failed dispatch; backend state unrecoverable "
+            "— engine must fail over")
     if f.kind == "nan":
         return _poison(batch)
     if f.kind == "poison":

@@ -160,6 +160,34 @@ class ScoringStageError(RuntimeError):
         self.attempts = attempts
 
 
+# Serving-tier taxonomy (ISSUE 19): every exception class the serving
+# package can surface, by NAME (matched walking ``type(exc).__mro__`` so
+# subclasses inherit their base's verdict) — name-keyed because the
+# jax-free policy module must not import ``serving.backend`` (which
+# imports jax). "retryable" = worth a restart/failover of the *caller*
+# (engine died, backend state lost, capacity); "fatal" = the request or
+# program is the problem (rejected, quarantined, cancelled, past its
+# deadline) and retrying re-fails. The drift-guard test greps
+# ``serving/`` for exception classes and asserts each lands a verdict
+# here, so routing can never silently default.
+SERVING_CLASS_VERDICTS = {
+    "ServingError": "fatal",
+    "RequestRejected": "fatal",
+    "QueueFullError": "retryable",
+    "RequestQuarantined": "fatal",
+    "ServingStallError": "retryable",
+    "EngineStopped": "retryable",
+    "RequestCancelled": "fatal",
+    "DeadlineExceeded": "fatal",
+    "SlotCacheLost": "retryable",
+    "BlockError": "fatal",
+    "BlockExhausted": "retryable",
+    # chaos's serving-fatal stand-in (runner/chaos.py) rides the same
+    # lost-backend-state verdict as the organic SlotCacheLost
+    "InjectedCacheLost": "retryable",
+}
+
+
 def classify_exception(exc: BaseException) -> str:
     """Return ``"retryable"`` or ``"fatal"`` for a training-run exception.
 
@@ -181,6 +209,10 @@ def classify_exception(exc: BaseException) -> str:
         # The stage wrapper is packaging, not policy: the verdict belongs
         # to the underlying dispatch/fetch error it carries.
         return classify_exception(exc.__cause__)
+    for klass in type(exc).__mro__:
+        verdict = SERVING_CLASS_VERDICTS.get(klass.__name__)
+        if verdict is not None:
+            return verdict
     if isinstance(exc, _FATAL_TYPES):
         return "fatal"
     msg = f"{type(exc).__name__}: {exc}"
@@ -210,12 +242,15 @@ def exception_summary(exc: BaseException) -> dict:
 
 
 # Traceback tails ending in these are the user's bug even when the captured
-# text carries no gRPC status word.
+# text carries no gRPC status word. The serving names ride the one
+# verdict table above, so text and exception classification can't drift.
 _FATAL_TRACEBACK_NAMES = ("ValueError", "TypeError", "KeyError",
                           "AssertionError", "AttributeError", "IndexError",
                           "ModuleNotFoundError", "ImportError",
                           "NotImplementedError", "TrainingDivergedError",
-                          "QuarantineOverflowError", "PoisonDataError")
+                          "QuarantineOverflowError", "PoisonDataError") + \
+    tuple(name for name, verdict in SERVING_CLASS_VERDICTS.items()
+          if verdict == "fatal")
 
 
 def classify_text(text: str) -> str:

@@ -9,10 +9,11 @@ lazily by :meth:`GenerationEngine.from_model`.
 from .draft import (DraftModelProvider, HistoryDraft, NGramDraft,
                     make_provider)
 from .engine import (ENGINE_SCOPED_EVENTS, PREFILLING,
-                     REQUEST_SCOPED_EVENTS, EngineStopped,
-                     GenerationEngine, QueueFullError, Request,
-                     RequestQuarantined, RequestRejected, ServingError,
-                     ServingStallError, StubBackend, bucket_length)
+                     REQUEST_SCOPED_EVENTS, DeadlineExceeded,
+                     EngineStopped, GenerationEngine, QueueFullError,
+                     Request, RequestCancelled, RequestQuarantined,
+                     RequestRejected, ServingError, ServingStallError,
+                     StubBackend, bucket_length)
 from .introspect import engine_debug_state, serving_snapshot
 from .paging import (BlockAllocator, BlockError, BlockExhausted,
                      PagedBlockManager)
@@ -22,6 +23,7 @@ __all__ = [
     "GenerationEngine", "Request", "StubBackend", "bucket_length",
     "ServingError", "RequestRejected", "QueueFullError",
     "RequestQuarantined", "ServingStallError", "EngineStopped",
+    "RequestCancelled", "DeadlineExceeded",
     "PREFILLING", "PrefixCache", "RadixPrefixCache", "BlockAllocator",
     "BlockError", "BlockExhausted", "PagedBlockManager", "NGramDraft",
     "HistoryDraft", "DraftModelProvider", "make_provider",

@@ -70,6 +70,7 @@ import os
 
 from ..core.runtime import GLOBAL_COMPILE_CACHE
 from ..models import llama as L
+from ..runner import chaos as chaos_lib
 from .paging import PagedBlockManager
 from .prefix import (PrefixCache, prefix_cache_budget_bytes,
                      usable_reuse)
@@ -341,9 +342,15 @@ class LlamaSlotBackend:
         self._pads[slot] = 0
         if commit and self.prefix_cache is not None:
             try:
+                chaos_lib.fire("serve_commit", batch=slot)
                 self._commit_prefix(slot, prompt, aligned_len)
             except Exception as e:  # noqa: BLE001 — caching is an
-                if not self._warned_commit:  # optimization, never fatal
+                # optimization, never fatal — UNLESS the error says the
+                # slot state itself is gone (injected cache_lost /
+                # SlotCacheLost): then the engine must fail over.
+                if getattr(e, "serving_fatal", False):
+                    raise
+                if not self._warned_commit:
                     self._warned_commit = True
                     log.warning("prefix-cache commit failed (%s: %s); "
                                 "suppressing further warnings",
@@ -481,6 +488,22 @@ class LlamaSlotBackend:
         self._cur[slot] = 0
         self._pads[slot] = 0
         self._tokens[slot] = 0
+
+    def rebuild(self):
+        """Failover hook (ISSUE 19): the slot cache was consumed or
+        wedged — allocate a fresh one (through the same ``_make_cache``
+        hook the TP subclass shards), reset every slot's host-side
+        frontier, and drop the prefix cache (its payloads were gathered
+        from the dead cache's layout; ``PrefixCache.clear()``
+        semantics). The engine re-admits live requests via the
+        preemption-resume path, so nothing here needs their state."""
+        self.cache = self._make_cache(self.model)
+        self._tokens[:] = 0
+        self._cur[:] = 0
+        self._pads[:] = 0
+        if self.prefix_cache is not None:
+            self.prefix_cache.clear()
+        self._warned_commit = False
 
 
 def pool_bytes_per_block(model, block_size: int,
@@ -731,9 +754,14 @@ class PagedLlamaSlotBackend(LlamaSlotBackend):
         self._pads[slot] = 0
         if commit:
             try:
+                chaos_lib.fire("serve_commit", batch=slot)
                 self.mgr.commit(slot, prompt)
             except Exception as e:  # noqa: BLE001 — caching is an
-                if not self._warned_commit:  # optimization, never fatal
+                # optimization, never fatal — UNLESS serving-fatal
+                # (injected cache_lost / SlotCacheLost): fail over.
+                if getattr(e, "serving_fatal", False):
+                    raise
+                if not self._warned_commit:
                     self._warned_commit = True
                     log.warning("radix commit failed (%s: %s); "
                                 "suppressing further warnings",
@@ -799,6 +827,27 @@ class PagedLlamaSlotBackend(LlamaSlotBackend):
         self._cur[slot] = 0
         self._pads[slot] = 0
         self._tokens[slot] = 0
+
+    def rebuild(self):
+        """Failover hook (ISSUE 19): fresh pool (same ``_make_pool``
+        hook the TP subclass shards), fresh block manager — allocator
+        free list, radix trie and every table reference start from
+        zero; the static pool facts (``mgr.info``) carry over."""
+        info = self.mgr.info
+        radix_on = self.mgr.radix is not None
+        self.tables[:] = 0  # every row parks on the trash block
+        self.mgr = PagedBlockManager(
+            self.num_slots, self.max_len, self.block_size,
+            self.pool_blocks, radix=radix_on,
+            on_table=self._set_table, copy_block=self._copy_block)
+        self.mgr.info = info
+        self.allocator = self.mgr.allocator
+        self.radix = self.mgr.radix
+        self.cache = self._make_pool(self.model)
+        self._tokens[:] = 0
+        self._cur[:] = 0
+        self._pads[:] = 0
+        self._warned_commit = False
 
 
 # ---------------------------------------------------------------------------

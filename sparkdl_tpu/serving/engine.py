@@ -89,6 +89,32 @@ own retry budget, never gang-fatally). ``SPARKDL_SERVE_STALL_S`` arms a
 wall-clock watchdog on every backend call — a wedged device surfaces as
 a classified ``ServingStallError`` instead of an eternal hang.
 
+**Failover (ISSUE 19).** A serving-fatal error (``SlotCacheLost`` — a
+jitted slot call died after consuming its donated cache — or a stall-
+watchdog fire) no longer kills the engine: every live request is
+snapshotted host-side (prompt + tokens-so-far, all already jax-free
+``Request`` state), the backend is torn down and rebuilt
+(``backend.rebuild()`` — fresh slot cache / paged pool / prefix trie),
+and the snapshots re-admit through the preemption-resume path with
+exactly-once delivery: streamed tokens are never re-emitted (the
+per-request ``delivered`` cursor survives the failover) and greedy
+output is bit-identical to an uninterrupted run. Zero-progress
+failovers in a row are bounded by ``SPARKDL_SERVE_FAILOVER_BUDGET``
+(exponential backoff via ``SPARKDL_SERVE_FAILOVER_BACKOFF_S``); past
+the engine budget the engine fails closed with the original cause, and
+a single request that personally survives ``budget`` failovers without
+gaining a token is quarantined individually instead of blocking the
+fleet. Requests also carry **deadlines** (``deadline_s`` on
+``submit()``, default ``SPARKDL_SERVE_DEADLINE_S``) and support
+**cancellation** (``Request.cancel()``): both are honored at the next
+iteration boundary — during prefill, decode, or mid-verify-window —
+freeing the slot and its KV blocks (no radix entry is ever committed
+for an aborted prefill). ``engine.drain()`` is the graceful-handoff
+primitive: stop admission, preempt live requests into resumable
+snapshots, and return them (``engine.resume(req)`` re-admits one); a
+drain wedged past ``SPARKDL_SERVE_STALL_S`` degrades to
+snapshot-and-stop instead of hanging the caller.
+
 Observability: per-request ``serve_queue`` / ``serve_prefill`` /
 ``serve_decode`` spans through the flight recorder, and (when the
 telemetry plane is armed) ``serving_queue_depth`` / ``serving_slots_
@@ -106,6 +132,7 @@ import os
 import threading
 import time
 
+from ..runner import chaos as chaos_lib
 from ..runner import events, telemetry
 from ..runner import sentinel as sentinel_lib
 from .introspect import register_engine
@@ -115,6 +142,7 @@ __all__ = [
     "GenerationEngine", "Request", "StubBackend", "bucket_length",
     "ServingError", "RequestRejected", "QueueFullError",
     "RequestQuarantined", "ServingStallError", "EngineStopped",
+    "RequestCancelled", "DeadlineExceeded",
     "PREFILLING", "BlockExhausted", "REQUEST_SCOPED_EVENTS",
     "ENGINE_SCOPED_EVENTS",
 ]
@@ -163,6 +191,16 @@ TP_ENV = "SPARKDL_SERVE_TP"
 # tp or single-device backends alike.
 KV_DTYPE_ENV = "SPARKDL_SERVE_KV_DTYPE"
 WEIGHT_DTYPE_ENV = "SPARKDL_SERVE_WEIGHT_DTYPE"
+# ISSUE 19 — serving survivability. FAILOVER_BUDGET bounds CONSECUTIVE
+# zero-progress failovers (any token emitted engine-wide resets the
+# streak — supervise()'s restart-budget rule); past it the engine fails
+# closed with the original cause. FAILOVER_BACKOFF_S is the base of the
+# exponential sleep before each rebuild (0 = none, the test/CI
+# default). DEADLINE_S is the default per-request deadline applied at
+# submit() when the caller passes none (0/unset = no deadline).
+FAILOVER_BUDGET_ENV = "SPARKDL_SERVE_FAILOVER_BUDGET"
+FAILOVER_BACKOFF_ENV = "SPARKDL_SERVE_FAILOVER_BACKOFF_S"
+DEADLINE_ENV = "SPARKDL_SERVE_DEADLINE_S"
 
 _DEFAULT_SLOTS = 8
 _DEFAULT_MAX_LEN = 2048
@@ -170,6 +208,7 @@ _DEFAULT_QUEUE_CAP = 128
 _DEFAULT_RETRIES = 1
 _DEFAULT_MIN_BUCKET = 16
 _DEFAULT_CHUNK = 32
+_DEFAULT_FAILOVER_BUDGET = 3
 # Block-allocation-latency-shaped bounds (seconds): a free-list pop is
 # microseconds; radix-eviction reclaims and CoW copies push into the
 # ms range — the histogram's job is to show when allocation stops
@@ -246,6 +285,16 @@ class EngineStopped(ServingError):
     """The engine stopped (or died) before this request completed."""
 
 
+class RequestCancelled(ServingError):
+    """The client cancelled the request (``Request.cancel()``); its
+    slot and KV blocks were freed at the next iteration boundary."""
+
+
+class DeadlineExceeded(ServingError):
+    """The request's deadline (``deadline_s`` at submit, or the
+    ``SPARKDL_SERVE_DEADLINE_S`` default) passed before completion."""
+
+
 def bucket_length(prompt_len: int, min_bucket: int = _DEFAULT_MIN_BUCKET
                   ) -> int:
     """Prefill bucket for a prompt: the next power of two >=
@@ -277,10 +326,12 @@ REQUEST_SCOPED_EVENTS = frozenset({
     "serve_reserve_retry", "serve_prefix_seed_failed",
     "serve_request_quarantined", "serve_request_preempted",
     "serve_admission_block_wait", "serve_request",
+    "serve_request_failover", "serve_request_cancelled",
 })
 ENGINE_SCOPED_EVENTS = frozenset({
     "serve_reject", "serve_step_retry", "serve_decode_stall",
-    "serve_draft", "serve_engine_fatal",
+    "serve_draft", "serve_engine_fatal", "serve_engine_failover",
+    "serve_engine_drain",
 })
 
 
@@ -345,6 +396,18 @@ class Request:
         self.preemptions = 0
         self.served_len = len(self.prompt)
         self._block_stalled = False
+        # Survivability (ISSUE 19): the exactly-once delivery cursor
+        # (== len(tokens); host-side, so it survives a backend rebuild
+        # — the failover audit's ground truth), consecutive failovers
+        # this request survived WITHOUT gaining a token (progress
+        # resets it; past the engine budget the request is quarantined
+        # individually), and the deadline/cancel flags the engine
+        # honors at the next iteration boundary.
+        self.delivered = 0
+        self.failovers = 0
+        self._len_at_failover: int | None = None
+        self.t_deadline: float | None = None
+        self._cancel = False
         # request-scoped phase ledger (ISSUE 13): the trace collector
         # reads these off the serve_decode span at retirement —
         # t_enqueue starts the CURRENT queued stint (reset on requeue,
@@ -375,6 +438,14 @@ class Request:
     def done(self) -> bool:
         return self._done.is_set()
 
+    def cancel(self):
+        """Ask the engine to abort this request (the client-disconnect
+        primitive). Honored at the next iteration boundary — queued,
+        PREFILLING, RUNNING, or mid-verify-window — freeing the slot
+        and its KV blocks; ``result()`` then raises
+        :class:`RequestCancelled`. Idempotent; a no-op once done."""
+        self._cancel = True
+
     def wait(self, timeout: float | None = None) -> bool:
         return self._done.wait(timeout)
 
@@ -401,13 +472,18 @@ class StubBackend:
     backend-outage bench leg measure queue/slot mechanics (and raw
     scheduler throughput) without a device.
 
-    Token stream per request: ``key = sum(prompt) + len(prompt)``,
-    ``tok_n = (seed + key·31 + n·7) % vocab_size`` — deterministic in
-    the prompt alone, so two runs of the same workload emit identical
-    streams regardless of slot placement, chunking, or prefix reuse
-    (the stall-free and blocking paths are trivially token-identical
-    here by construction — the CPU llama tests carry the real
-    equivalence proof). ``step_s``/``prefill_s``/``prefill_tok_s`` add
+    Token stream per request: a fold over the SERVED sequence —
+    ``v = sum(served) + len(served)`` after prefill, each emission
+    ``tok = (seed + v·31) % vocab_size`` then ``v += tok + 1`` — so the
+    stream is deterministic in the prompt alone AND resume-consistent:
+    prefilling ``prompt + tokens-so-far`` (the preemption/failover
+    resume) lands the chain on exactly the state an uninterrupted run
+    would hold, so two runs of the same workload emit identical streams
+    regardless of slot placement, chunking, prefix reuse, preemption or
+    failover (the CPU llama tests carry the real equivalence proof).
+    The mod-``vocab_size`` dynamics stay eventually periodic, so a
+    small vocab still yields the repetitive, n-gram-predictable text
+    the speculative legs ride. ``step_s``/``prefill_s``/``prefill_tok_s`` add
     synthetic per-call latency (bench shaping): a blocking prefill
     costs ``prefill_s + prefill_tok_s·bucket``, one chunk costs
     ``prefill_s + prefill_tok_s·C`` — per-token cost models the real
@@ -439,7 +515,9 @@ class StubBackend:
         self.spec_tok_s = spec_tok_s
         self.seed = seed
         self.prefix_bytes_per_token = int(prefix_bytes_per_token)
-        self._state = [(0, 0)] * num_slots  # (prompt_key, n_emitted)
+        # (prompt_key, n_emitted, chain) — key is the served prompt's
+        # sum+len (kept for test hooks), chain drives the token fold
+        self._state = [(0, 0, 0)] * num_slots
         budget = prefix_cache_budget_bytes() if prefix_cache_bytes is None \
             else max(0, int(prefix_cache_bytes))
         # Paged mirror (ISSUE 11): block_size arms the SAME
@@ -462,7 +540,18 @@ class StubBackend:
             self.prefix_cache = PrefixCache(budget) if budget > 0 else None
 
     def _tok(self, key: int, n: int) -> int:
-        return (self.seed + key * 31 + n * 7) % self.vocab_size
+        """Emission hook: ``key`` is the fold-chain value at this
+        position (== sum+len of everything served so far), ``n`` the
+        emission index since the last prefill — the default ignores
+        ``n`` so resumes (which reset it) stay stream-identical."""
+        return (self.seed + key * 31) % self.vocab_size
+
+    def _emit(self, slot: int):
+        """Advance the slot's fold chain one token."""
+        key, n, v = self._state[slot]
+        tok = self._tok(v, n)
+        self._state[slot] = (key, n + 1, v + tok + 1)
+        return tok
 
     def prefill(self, slot: int, prompt, bucket: int) -> int:
         if self.paged:
@@ -470,13 +559,13 @@ class StubBackend:
         if self.prefill_s or self.prefill_tok_s:
             time.sleep(self.prefill_s + self.prefill_tok_s * bucket)
         key = sum(prompt) + len(prompt)
-        self._state[slot] = (key, 1)
-        return self._tok(key, 0)
+        self._state[slot] = (key, 0, key)
+        return self._emit(slot)
 
     # -- chunked (stall-free) protocol, mirroring LlamaSlotBackend --------
     def begin_prefill(self, slot: int, prompt, chunk: int) -> int:
         from .prefix import usable_reuse
-        self._state[slot] = (0, 0)
+        self._state[slot] = (0, 0, 0)
         if self.paged:
             return self.mgr.reserve_prompt(slot, prompt, chunk)
         if self.prefix_cache is None:
@@ -499,15 +588,26 @@ class StubBackend:
     def finish_prefill(self, slot: int, prompt, last_tok: int,
                        aligned_len: int, commit: bool = True) -> int:
         key = sum(prompt) + len(prompt)
-        self._state[slot] = (key, 1)
+        self._state[slot] = (key, 0, key)
         if commit:
-            if self.paged:
-                self.mgr.commit(slot, prompt)
-            elif self.prefix_cache is not None:
-                self.prefix_cache.put(
-                    tuple(prompt), tuple(prompt),
-                    len(prompt) * self.prefix_bytes_per_token)
-        return self._tok(key, 0)
+            # Commit failures degrade (the entry just isn't cached) —
+            # unless serving-fatal (injected cache_lost): that means
+            # the slot state itself is gone and the engine must fail
+            # over, exactly the llama backends' posture.
+            try:
+                chaos_lib.fire("serve_commit", batch=slot)
+                if self.paged:
+                    self.mgr.commit(slot, prompt)
+                elif self.prefix_cache is not None:
+                    self.prefix_cache.put(
+                        tuple(prompt), tuple(prompt),
+                        len(prompt) * self.prefix_bytes_per_token)
+            except Exception as e:  # noqa: BLE001 — degrade, not fail
+                if getattr(e, "serving_fatal", False):
+                    raise
+                log.warning("stub prefix commit failed (slot %s): %s",
+                            slot, e)
+        return self._emit(slot)
 
     def prefix_stats(self) -> dict | None:
         if self.paged:
@@ -531,16 +631,29 @@ class StubBackend:
     def release(self, slot: int):
         if self.paged:
             self.mgr.release(slot)
-        self._state[slot] = (0, 0)
+        self._state[slot] = (0, 0, 0)
+
+    def rebuild(self):
+        """Failover hook (ISSUE 19): discard every slot's chain state
+        and rebuild the paged pool / prefix trie from scratch — the
+        jax-free mirror of the llama backends' cache teardown."""
+        self._state = [(0, 0, 0)] * self.num_slots
+        if self.paged:
+            from .paging import PagedBlockManager
+            radix = self.mgr.radix is not None
+            self.mgr = PagedBlockManager(self.num_slots, self.max_len,
+                                         self.block_size,
+                                         self.pool_blocks, radix=radix)
+            self.allocator = self.mgr.allocator
+        elif self.prefix_cache is not None:
+            self.prefix_cache.clear()
 
     def step(self, active_slots) -> list[int]:
         if self.step_s:
             time.sleep(self.step_s)
         out = [0] * self.num_slots
         for s in active_slots:
-            key, n = self._state[s]
-            out[s] = self._tok(key, n)
-            self._state[s] = (key, n + 1)
+            out[s] = self._emit(s)
         return out
 
     # -- speculative verify protocol (ISSUE 12), mirrored jax-free --------
@@ -557,15 +670,20 @@ class StubBackend:
             time.sleep(self.step_s + self.spec_tok_s * k)
         out = [[0] * (k + 1) for _ in range(self.num_slots)]
         for s in active_slots:
-            key, n = self._state[s]
-            out[s] = [self._tok(key, n + i) for i in range(k + 1)]
+            key, n, v = self._state[s]
+            row = []
+            for i in range(k + 1):
+                tok = self._tok(v, n + i)
+                row.append(tok)
+                v += tok + 1
+            out[s] = row
         return out
 
     def commit_spec(self, slot: int, n_tokens: int, last_tok: int):
         """Advance the slot's stream past ``n_tokens`` committed
         positions (reject = simply not advancing)."""
-        key, n = self._state[slot]
-        self._state[slot] = (key, n + int(n_tokens))
+        for _ in range(int(n_tokens)):
+            self._emit(slot)
 
 
 class GenerationEngine:
@@ -586,7 +704,10 @@ class GenerationEngine:
                  prefill_chunk: int | None = None,
                  prefill_budget: int | None = None,
                  spec_k: int | None = None,
-                 draft_provider=None):
+                 draft_provider=None,
+                 failover_budget: int | None = None,
+                 failover_backoff_s: float | None = None,
+                 deadline_s: float | None = None):
         self.backend = backend
         self.eos_id = eos_id
         # Paged backend (ISSUE 11): admission additionally gates on KV-
@@ -648,6 +769,19 @@ class GenerationEngine:
             else _env_num(STALL_ENV, 0.0, float)
         self.min_bucket = min_bucket if min_bucket is not None \
             else _env_num(MIN_BUCKET_ENV, _DEFAULT_MIN_BUCKET)
+        # Survivability knobs (ISSUE 19): see the env-constant comments.
+        self.failover_budget = max(0, failover_budget
+                                   if failover_budget is not None
+                                   else _env_num(FAILOVER_BUDGET_ENV,
+                                                 _DEFAULT_FAILOVER_BUDGET))
+        self.failover_backoff_s = max(0.0, failover_backoff_s
+                                      if failover_backoff_s is not None
+                                      else _env_num(FAILOVER_BACKOFF_ENV,
+                                                    0.0, float))
+        self.default_deadline_s = max(0.0, deadline_s
+                                      if deadline_s is not None
+                                      else _env_num(DEADLINE_ENV, 0.0,
+                                                    float))
         # Speculative decode (ISSUE 12): k = 0 (default) is the EXACT
         # PR 11 path — no draft provider, no verify program, nothing
         # speculation-shaped runs. k > 0 requires the backend's verify
@@ -688,6 +822,23 @@ class GenerationEngine:
         self._stop_mode: str | None = None  # None | "drain" | "now"
         self._fatal: BaseException | None = None
         self._watch_pool = None  # lazy ThreadPoolExecutor(1) when stall_s
+        # Failover supervisor state (ISSUE 19): re-entrancy latch,
+        # consecutive zero-progress streak, chaos/watchdog call counter,
+        # the note the fail-closed EngineStopped carries, and the
+        # operator-facing ledger introspect/snapshot expose.
+        self._failing_over = False
+        self._failover_streak = 0
+        self._tokens_at_failover = -1
+        self._backend_calls = 0
+        self._fatal_note: str | None = None
+        self._awaiting_recovery = False
+        self._t_fault: float | None = None
+        self._failover_info: dict = {
+            "state": "healthy", "count": 0, "streak": 0,
+            "last_cause": None, "last_t": None, "resumed_total": 0,
+            "quarantined_total": 0, "last_backoff_s": 0.0,
+            "last_recovery_s": None,
+        }
         self.stats = {
             "submitted": 0, "rejected": 0, "completed": 0,
             "quarantined": 0, "failed": 0, "tokens_out": 0, "steps": 0,
@@ -702,6 +853,12 @@ class GenerationEngine:
             # request when EVERY running slot is block-stalled)
             "admission_block_waits": 0, "block_stall_events": 0,
             "preemptions": 0,
+            # survivability ledger (ISSUE 19): engine failovers
+            # survived, requests re-admitted / individually quarantined
+            # across them, and deadline/cancel aborts (never counted
+            # quarantined)
+            "failovers": 0, "failover_resumed": 0,
+            "failover_quarantined": 0, "cancelled": 0,
             # speculative-decode ledger (ISSUE 12): verify iterations,
             # draft tokens the target agreed with (each one a decode
             # dispatch saved) vs rejected (wasted draft+verify columns)
@@ -882,7 +1039,8 @@ class GenerationEngine:
     # -- admission --------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 16, *,
                stream_cb=None, block: bool = True,
-               timeout: float | None = None) -> Request:
+               timeout: float | None = None,
+               deadline_s: float | None = None) -> Request:
         """Queue one request; returns its :class:`Request` handle.
 
         Admission control is synchronous: an invalid prompt (empty, or
@@ -891,6 +1049,12 @@ class GenerationEngine:
         :class:`RequestRejected`; a full queue blocks (``block=True``,
         up to ``timeout``) or raises :class:`QueueFullError` — that is
         the backpressure contract, the caller owns retry/shedding.
+
+        ``deadline_s`` caps the request's total wall time from submit
+        (default ``SPARKDL_SERVE_DEADLINE_S``; 0/None = no deadline):
+        past it the engine aborts the request at the next iteration
+        boundary, freeing its slot and KV blocks, and ``result()``
+        raises :class:`DeadlineExceeded`.
         """
         prompt = [int(t) for t in prompt_ids]
         if not prompt:
@@ -963,6 +1127,10 @@ class GenerationEngine:
                     raise EngineStopped("engine is stopped")
             req = Request(next(self._ids), prompt, int(max_new_tokens),
                           bucket, stream_cb)
+            limit = deadline_s if deadline_s is not None \
+                else self.default_deadline_s
+            if limit and limit > 0:
+                req.t_deadline = req.t_submit + float(limit)
             self._queue.append(req)
             self.stats["submitted"] += 1
             depth = len(self._queue)
@@ -994,14 +1162,34 @@ class GenerationEngine:
         fallback (``SPARKDL_SERVE_STALL_FREE=0``): retire/refill free
         slots with whole-prompt prefills, then decode. Returns True when
         any work happened; False when idle — the inline-drive loop
-        condition."""
+        condition.
+
+        Failover seam (ISSUE 19): a serving-fatal error or stall
+        surfacing from ANY backend call inside the iteration is caught
+        HERE — the single supervisor point — and routed through
+        :meth:`_handle_fatal`; when the failover succeeds (backend
+        rebuilt, live requests re-admitted) the iteration reports
+        worked=True and serving continues."""
         if self._fatal is not None:
             raise EngineStopped("engine died") from self._fatal
+        try:
+            return self._step_inner()
+        except Exception as e:  # noqa: BLE001 — failover routing
+            if not (getattr(e, "serving_fatal", False)
+                    or isinstance(e, ServingStallError)):
+                raise  # scheduler bug etc: the old fail-everything path
+            self._handle_fatal(e)
+            if self._fatal is None:
+                return True  # failed over: rebuilt + re-admitted
+            raise
+
+    def _step_inner(self) -> bool:
+        worked = self._reap_cancelled()
         if self.stall_free:
-            worked = self._admit() > 0
+            worked = self._admit() > 0 or worked
             worked = self._prefill_tick() or worked
         else:
-            worked = self._refill() > 0
+            worked = self._refill() > 0 or worked
         with self._lock:
             busy = sum(r is not None for r in self._slots)
             active = [(s, r) for s, r in enumerate(self._slots)
@@ -1043,6 +1231,64 @@ class GenerationEngine:
                     req.write_pos += 1
         return True
 
+    # -- deadlines / cancellation (ISSUE 19) ------------------------------
+    @staticmethod
+    def _should_cancel(req: Request, now: float) -> bool:
+        if req.state in (DONE, FAILED):
+            return False
+        return req._cancel or (req.t_deadline is not None
+                               and now >= req.t_deadline)
+
+    def _reap_cancelled(self) -> bool:
+        """Honor ``Request.cancel()`` and expired deadlines at the
+        iteration boundary: pull the victims out of the queue and the
+        slot table, release their slots (a paged release derefs every
+        KV block; a mid-prefill abort never committed a radix/prefix
+        entry, so there is nothing to roll back), and finish them
+        FAILED with :class:`RequestCancelled` / :class:`DeadlineExceeded`
+        — counted in ``cancelled``, never ``quarantined``."""
+        now = time.time()
+        victims = []
+        with self._work:
+            for r in list(self._queue):
+                if self._should_cancel(r, now):
+                    self._queue.remove(r)
+                    victims.append(r)
+            for s, r in enumerate(self._slots):
+                if r is not None and self._should_cancel(r, now):
+                    self._slots[s] = None
+                    victims.append(r)
+            if victims:
+                self._work.notify_all()
+        for r in victims:
+            slot, r.slot = r.slot, None
+            self._release_slot(slot)
+            self._finish_cancelled(r, now)
+        return bool(victims)
+
+    def _finish_cancelled(self, req: Request, now: float):
+        reason = "cancelled" if req._cancel else "deadline"
+        req.state = FAILED
+        req.finish_reason = reason
+        if req._cancel:
+            req.error = RequestCancelled(
+                f"request {req.id} cancelled by the client "
+                f"({len(req.tokens)} token(s) already streamed)")
+        else:
+            req.error = DeadlineExceeded(
+                f"request {req.id} exceeded its deadline "
+                f"({now - req.t_submit:.3f}s since submit)")
+        req.t_done = now
+        req.chunk_plan = None
+        self._end_block_stall(req, time.perf_counter())
+        self.stats["cancelled"] += 1
+        events.event("serve_request_cancelled", request=req.id,
+                     reason=reason, generated=len(req.tokens),
+                     **_req_trace(req))
+        self._metric("counter", "serving_requests_cancelled_total")
+        self._close_request_span(req, reason)
+        req._done.set()
+
     def run_until_idle(self):
         """Drive inline until the queue is empty and every slot idle."""
         while self.step():
@@ -1059,16 +1305,64 @@ class GenerationEngine:
             self._thread.start()
         return self
 
-    def stop(self, drain: bool = True, timeout: float | None = None):
+    def stop(self, drain: bool = True, timeout: float | None = None
+             ) -> list[Request]:
         """Stop the background loop. ``drain=True`` finishes queued and
         in-flight requests first; ``drain=False`` fails them with
-        :class:`EngineStopped`."""
+        :class:`EngineStopped`. A drain wedged past
+        ``SPARKDL_SERVE_STALL_S`` (or ``timeout``) degrades to
+        snapshot-and-stop: the still-live requests are preempted into
+        resumable snapshots and returned (empty list on a clean
+        drain/stop)."""
+        return self._shutdown("drain" if drain else "now", timeout)
+
+    def drain(self, timeout: float | None = None) -> list[Request]:
+        """Graceful handoff (ISSUE 19): stop admission, preempt every
+        live request into a resumable snapshot (``prompt`` +
+        ``tokens``-so-far on the returned :class:`Request` handles —
+        the preemption-resume form), and return them. Feed each to
+        :meth:`resume` on a fresh engine to continue exactly where it
+        left off; already-streamed tokens are never re-emitted."""
+        return self._shutdown("snapshot", timeout)
+
+    def _shutdown(self, mode: str, timeout: float | None
+                  ) -> list[Request]:
+        """The ONE stop/drain implementation. ``mode``: "drain"
+        (finish everything, degrade to snapshot past the stall budget),
+        "snapshot" (immediate preempt-and-return), "now" (fail
+        pending)."""
         with self._work:
-            self._stop_mode = "drain" if drain else "now"
+            self._stop_mode = "drain" if mode == "drain" else "now"
             self._work.notify_all()
             t = self._thread
-        if t is not None:
+        snaps: list[Request] = []
+        if mode == "drain" and t is not None:
+            budget = timeout
+            if self.stall_s and self.stall_s > 0:
+                budget = self.stall_s if budget is None \
+                    else min(budget, self.stall_s)
+            t.join(budget)
+            if t.is_alive():
+                # Wedged drain: never hang the caller — degrade to
+                # snapshot-and-stop, returning the resumable snapshots.
+                log.warning("drain still running after %ss; degrading "
+                            "to snapshot-and-stop", budget)
+                with self._work:
+                    self._stop_mode = "now"
+                    self._work.notify_all()
+                mode = "snapshot"
+        if mode == "now" and t is not None:
             t.join(timeout)
+        if mode == "snapshot":
+            if t is not None:
+                # Give the loop one beat to notice stop_mode="now" and
+                # park between iterations; the in-flight guards make a
+                # late backend return harmless either way.
+                t.join(timeout if timeout is not None
+                       else (self.stall_s or 1.0))
+            snaps = self._detach_all()
+            events.event("serve_engine_drain", requests=len(snaps))
+        if t is not None:
             if t.is_alive():
                 # The loop is wedged past the join timeout: leave
                 # _thread set so a later start() cannot spawn a SECOND
@@ -1080,11 +1374,33 @@ class GenerationEngine:
                 with self._lock:
                     if self._thread is t:  # a concurrent start() may
                         self._thread = None  # already own the handle
-        if not drain:
+        if mode == "now":
             self._fail_pending(EngineStopped("engine stopped"))
         pool, self._watch_pool = self._watch_pool, None
         if pool is not None:
             pool.shutdown(wait=False)
+        return snaps
+
+    def resume(self, req: Request) -> Request:
+        """Re-admit a drained/preempted snapshot (from :meth:`drain` or
+        a degraded stop) — on this engine or a fresh one. The request
+        keeps its handle and id; its prefill consumes
+        ``prompt + tokens-so-far`` and the stream continues exactly
+        where it left off (greedy determinism), nothing re-emitted."""
+        if req.state in (DONE, FAILED):
+            return req
+        with self._work:
+            if self._stop_mode is not None or self._fatal is not None:
+                raise EngineStopped("engine is stopped")
+            req.state = QUEUED
+            req.slot = None
+            req.chunk_plan = None
+            req._block_stalled = False
+            req.t_enqueue = time.time()
+            self._queue.append(req)
+            self.stats["submitted"] += 1
+            self._work.notify_all()
+        return req
 
     def __enter__(self):
         return self.start()
@@ -1113,8 +1429,13 @@ class GenerationEngine:
                 try:
                     self.step()
                 except Exception as e:  # noqa: BLE001 — record, not die
+                    # step() already routed failover-eligible errors; a
+                    # raise here means failover was impossible/failed
+                    # (or a scheduler bug) — record and die, unless a
+                    # concurrent path somehow recovered.
                     self._handle_fatal(e)
-                    break
+                    if self._fatal is not None:
+                        break
         finally:
             # A stop() whose join timed out leaves _thread set (so a
             # concurrent start() can't double-drive the slot table);
@@ -1282,8 +1603,7 @@ class GenerationEngine:
             return False
         except Exception as e:  # noqa: BLE001 — reuse is an optimization
             if getattr(e, "serving_fatal", False):
-                self._handle_fatal(e)
-                raise
+                raise  # step()'s failover seam owns it
             if self.paged:
                 # Paged begin_prefill is RESERVATION, not just reuse: a
                 # cold fallback would chunk-write through an unreserved
@@ -1415,8 +1735,7 @@ class GenerationEngine:
             raise  # a wedged device is never a per-request fault
         except Exception as e:  # noqa: BLE001 — per-request isolation
             if getattr(e, "serving_fatal", False):
-                self._handle_fatal(e)
-                raise
+                raise  # step()'s failover seam owns it
             dt_fail = time.perf_counter() - t0
             self._note_stall(dt_fail, n_running)
             req.prefill_spent_s += dt_fail  # failed-attempt compute is
@@ -1445,11 +1764,12 @@ class GenerationEngine:
         self.stats["prefill_chunks"] += 1
         if final:
             self.stats["prefills"] += 1
-            if req.state == FAILED:
-                # The engine failed over (stop(drain=False) / fatal)
-                # while the chunk was in flight: the request was already
-                # reported failed — never resurrect it to RUNNING or
-                # stream a token after the failure.
+            if req.state != PREFILLING:
+                # The engine failed, failed over, or drained while the
+                # chunk was in flight: the request was already reported
+                # failed — or detached into a resumable snapshot (state
+                # QUEUED) awaiting re-admission. Never resurrect it to
+                # RUNNING or stream a token from the dead stint.
                 return
             req.state = RUNNING
             req.write_pos = req.served_len  # decode writes from L
@@ -1492,11 +1812,13 @@ class GenerationEngine:
                 # the stall-free scheduler is measured against).
                 self._note_stall(time.perf_counter() - t0, n_running)
                 self.stats["prefills"] += 1
-                if req.state == FAILED:
-                    # The engine failed over (stop(drain=False) / fatal)
-                    # while this prefill was in flight: the request was
-                    # already reported failed — never resurrect it to
-                    # RUNNING or stream a token after the failure.
+                if req.state == FAILED or self._slots[slot] is not req:
+                    # The engine failed, failed over, or drained while
+                    # this prefill was in flight: the request was
+                    # already reported failed — or detached from the
+                    # slot into a resumable snapshot. Never resurrect
+                    # it to RUNNING or stream a token from the dead
+                    # stint.
                     return False
                 req.state = RUNNING
                 req.served_len = len(served)
@@ -1512,9 +1834,8 @@ class GenerationEngine:
                 if getattr(e, "serving_fatal", False):
                     # e.g. backend.SlotCacheLost: the donated cache was
                     # consumed by the failing call — retrying reads a
-                    # deleted buffer, so fail over instead of evicting
-                    # innocent requests one by one.
-                    self._handle_fatal(e)
+                    # deleted buffer, so let step()'s failover seam
+                    # rebuild instead of evicting innocents one by one.
                     raise
                 self._note_stall(time.perf_counter() - t0, n_running)
                 last = e
@@ -1579,8 +1900,7 @@ class GenerationEngine:
                 raise
             except Exception as e:  # noqa: BLE001 — retry taxonomy below
                 if getattr(e, "serving_fatal", False):
-                    self._handle_fatal(e)
-                    raise
+                    raise  # step()'s failover seam owns it
                 attempts += 1
                 if attempts <= self.retries:
                     self.stats["step_retries"] += 1
@@ -1650,8 +1970,7 @@ class GenerationEngine:
             if self.paged and d:
                 ok = 0
                 for i in range(len(d)):
-                    if self.backend.ensure_block_for(
-                            slot, req.write_pos + 1 + i):
+                    if self._ensure_block(slot, req.write_pos + 1 + i):
                         ok += 1
                     else:
                         break
@@ -1696,8 +2015,13 @@ class GenerationEngine:
                          float(len(emit)), buckets=self._spec_buckets)
             delivered, last = 0, None
             for t in emit:
-                if req.state != RUNNING:
-                    break  # retired (EOS / length) mid-window
+                if req.state != RUNNING or \
+                        self._should_cancel(req, time.time()):
+                    # retired (EOS / length), cancelled, or past its
+                    # deadline mid-verify-window: stop emitting — the
+                    # reaper at the next iteration boundary finishes a
+                    # cancel/deadline victim without streaming more
+                    break
                 self._deliver(req, t)
                 req.write_pos += 1
                 delivered += 1
@@ -1711,6 +2035,22 @@ class GenerationEngine:
         return True
 
     # -- paged-mode block growth / backpressure ---------------------------
+    def _ensure_block(self, slot: int, pos: int) -> bool:
+        """``backend.ensure_block_for`` under the ``serve_alloc`` chaos
+        site: an injected serving-fatal fault (``cache_lost``)
+        propagates to step()'s failover seam; any other injected or
+        organic allocator error degrades to False — the block-stall
+        backpressure path, never a crash."""
+        try:
+            chaos_lib.fire("serve_alloc", batch=slot)
+            return bool(self.backend.ensure_block_for(slot, pos))
+        except Exception as e:  # noqa: BLE001 — alloc faults backpressure
+            if getattr(e, "serving_fatal", False):
+                raise
+            log.warning("ensure_block_for(%s, %s) failed: %s: %s",
+                        slot, pos, type(e).__name__, e)
+            return False
+
     def _filter_block_stalled(self, active):
         """Secure a writable frontier block for every RUNNING slot
         (oldest admitted first — FIFO priority when blocks are scarce).
@@ -1725,7 +2065,7 @@ class GenerationEngine:
         now = time.perf_counter()
         for slot, req in ordered:
             req._block_stalled = False
-            if self.backend.ensure_block_for(slot, req.write_pos):
+            if self._ensure_block(slot, req.write_pos):
                 self._end_block_stall(req, now)
                 ok.append((slot, req))
             else:
@@ -1741,7 +2081,7 @@ class GenerationEngine:
             for slot, req in stalled:
                 if req is victim:
                     continue
-                if self.backend.ensure_block_for(slot, req.write_pos):
+                if self._ensure_block(slot, req.write_pos):
                     req._block_stalled = False
                     self._end_block_stall(req, time.perf_counter())
                     ok.append((slot, req))
@@ -1817,6 +2157,12 @@ class GenerationEngine:
         self.stats["tokens_out"] += 1
         self._metric("counter", "serving_tokens_total")
         now = time.time()
+        if self._awaiting_recovery and req.failovers:
+            # recovery_s = fault-to-first-resumed-token (the ISSUE 19
+            # survivability headline serve_bench reads off the snapshot)
+            self._failover_info["last_recovery_s"] = max(
+                0.0, now - (self._t_fault or now))
+            self._awaiting_recovery = False
         if req.t_first_token is None:
             req.t_first_token = now
             self._metric("histogram", "serving_ttft_s",
@@ -1829,6 +2175,12 @@ class GenerationEngine:
                 self.stats["callback_errors"] += 1  # never kill the loop
                 log.exception("serve stream callback failed (request %s)",
                               req.id)
+        # Exactly-once delivery cursor: every token is appended +
+        # streamed in this one place, so cursor == len(tokens) always —
+        # a failover that re-emitted (or a resume that skipped) a token
+        # would break the invariant, which is exactly what the chaos
+        # smoke's cursor audit checks.
+        req.delivered = len(req.tokens)
         if self.eos_id is not None and tok == self.eos_id:
             self._retire(req, "eos")
         elif len(req.tokens) >= req.max_new_tokens:
@@ -1912,8 +2264,25 @@ class GenerationEngine:
         req._done.set()
 
     # -- failure plumbing -------------------------------------------------
+    # Chaos sites (ISSUE 19): every jitted-call stage the watchdog
+    # already names maps onto one of the serving fault-injection sites,
+    # so the whole failover posture is provable on CPU. The rebuild
+    # stage is deliberately absent — injecting into the recovery path
+    # itself would recurse (the _failing_over latch guards regardless).
+    _CHAOS_SITES = {
+        "prefill": "serve_prefill", "prefill_chunk": "serve_prefill",
+        "finish_prefill": "serve_prefill", "prefix_seed": "serve_alloc",
+        "decode_step": "serve_decode", "spec_verify": "serve_decode",
+    }
+
     def _timed(self, fn, stage: str):
-        """Run one backend call under the optional stall watchdog."""
+        """Run one backend call under the optional stall watchdog (and
+        the serving chaos sites — fired on the engine thread so an
+        injected fault takes the organic error's exact control path)."""
+        site = self._CHAOS_SITES.get(stage)
+        if site is not None:
+            self._backend_calls += 1
+            chaos_lib.fire(site, step=self._backend_calls)
         if not self.stall_s or self.stall_s <= 0:
             return fn()
         if self._watch_pool is None:
@@ -1925,24 +2294,182 @@ class GenerationEngine:
         try:
             return fut.result(timeout=self.stall_s)
         except FutTimeout:
-            err = ServingStallError(
+            # step()'s failover seam owns the stall (rebuild or fail
+            # closed); raising is all the watchdog does now.
+            raise ServingStallError(
                 f"serving {stage} exceeded SPARKDL_SERVE_STALL_S="
-                f"{self.stall_s:g}s")
-            self._handle_fatal(err)
-            raise err from None
+                f"{self.stall_s:g}s") from None
 
     def _handle_fatal(self, exc: BaseException):
-        # Idempotent: a stall surfaces through both _timed and the
-        # background loop's catch — one failure must record ONE
-        # serve_engine_fatal event and run _fail_pending once.
+        """The serving supervisor (ISSUE 19): try to fail over —
+        snapshot live requests, rebuild the backend, re-admit — and
+        only when that is impossible (no ``backend.rebuild``, an
+        ineligible error class, budget exhausted, or the rebuild itself
+        died) fall back to the fail-closed posture: record ONE
+        ``serve_engine_fatal`` event and fail everything pending.
+        Idempotent and latch-guarded — a failure surfacing through
+        several paths runs one recovery."""
         with self._lock:
-            if self._fatal is not None:
+            if self._fatal is not None or self._failing_over:
                 return
-            self._fatal = exc
+            self._failing_over = True
+        ok = False
+        try:
+            ok = self._can_failover(exc) and self._failover(exc)
+        finally:
+            with self._lock:
+                if not ok and self._fatal is None:
+                    self._fatal = exc
+                self._failing_over = False
+        if ok:
+            return
+        note = f": {self._fatal_note}" if self._fatal_note else ""
         events.event("serve_engine_fatal",
-                     error=f"{type(exc).__name__}: {exc}"[:300])
+                     error=f"{type(exc).__name__}: {exc}"[:300] + note)
         self._fail_pending(EngineStopped(
-            f"engine died: {type(exc).__name__}: {exc}"))
+            f"engine died{note}: {type(exc).__name__}: {exc}"))
+
+    def _can_failover(self, exc: BaseException) -> bool:
+        """Failover eligibility: only errors that mean the BACKEND
+        STATE is gone/wedged (``serving_fatal``-flagged, or a stall-
+        watchdog fire) — an arbitrary scheduler exception keeps the
+        conservative fail-everything posture — and only when the
+        backend can actually be rebuilt."""
+        if not (getattr(exc, "serving_fatal", False)
+                or isinstance(exc, ServingStallError)):
+            return False
+        return callable(getattr(self.backend, "rebuild", None))
+
+    def _failover(self, cause: BaseException) -> bool:
+        """One failover: budget/backoff accounting, snapshot + detach
+        every live request, rebuild the backend (fresh slot cache /
+        paged pool / prefix trie), re-admit the snapshots through the
+        preemption-resume path (FIFO seniority preserved), quarantining
+        individually any request that has personally survived
+        ``failover_budget`` failovers without gaining a token. Returns
+        False to fail closed."""
+        budget = self.failover_budget
+        if self.stats["tokens_out"] > self._tokens_at_failover >= 0:
+            self._failover_streak = 0  # progress resets the streak
+        self._failover_streak += 1
+        self._tokens_at_failover = self.stats["tokens_out"]
+        if self._failover_streak > budget:
+            self._fatal_note = (
+                f"failover budget exhausted "
+                f"({FAILOVER_BUDGET_ENV}={budget})")
+            self._failover_info.update(
+                state="exhausted", streak=self._failover_streak,
+                last_cause=f"{type(cause).__name__}: {cause}"[:200])
+            return False
+        t_fault = time.time()
+        backoff = self.failover_backoff_s * (
+            2 ** (self._failover_streak - 1))
+        if backoff > 0:
+            time.sleep(backoff)
+        live = self._detach_all()
+        # A stall-triggered failover leaves the wedged call sleeping in
+        # the 1-worker watchdog pool — the rebuild must not queue behind
+        # it. Abandon the pool (daemon worker; the in-flight guards make
+        # a late return harmless) and let _timed lazily build a fresh
+        # one around the rebuild.
+        pool, self._watch_pool = self._watch_pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
+        try:
+            self._timed(self.backend.rebuild, "failover_rebuild")
+        except Exception as e:  # noqa: BLE001 — rebuild died: fail closed
+            self._fatal_note = (f"backend rebuild failed: "
+                                f"{type(e).__name__}: {e}")
+            self._failover_info.update(
+                state="rebuild_failed", streak=self._failover_streak,
+                last_cause=f"{type(cause).__name__}: {cause}"[:200])
+            with self._work:
+                # Put the detached snapshots back so the fail-closed
+                # path (_fail_pending) reports them — never strand a
+                # request in QUEUED with no engine working it.
+                self._queue.extendleft(reversed(live))
+                self._work.notify_all()
+            return False
+        resumed, keep = 0, []
+        for r in live:
+            prev = r._len_at_failover
+            if prev is not None and len(r.tokens) <= prev:
+                r.failovers += 1  # zero progress since the last one
+            else:
+                r.failovers = 1
+            r._len_at_failover = len(r.tokens)
+            if r.failovers > budget:
+                r.failures = max(r.failures, r.failovers)
+                self.stats["failover_quarantined"] += 1
+                self._quarantine(r, cause)
+                continue
+            events.event("serve_request_failover", request=r.id,
+                         generated=len(r.tokens), failovers=r.failovers,
+                         **_req_trace(r))
+            keep.append(r)
+            resumed += 1
+        with self._work:
+            self._queue.extendleft(reversed(keep))
+            self._work.notify_all()
+        self.stats["failovers"] += 1
+        self.stats["failover_resumed"] += resumed
+        self._failover_info.update(
+            state="recovered", count=self.stats["failovers"],
+            streak=self._failover_streak,
+            last_cause=f"{type(cause).__name__}: {cause}"[:200],
+            last_t=t_fault,
+            resumed_total=self.stats["failover_resumed"],
+            quarantined_total=self.stats["failover_quarantined"],
+            last_backoff_s=backoff, last_recovery_s=None)
+        self._awaiting_recovery = True
+        self._t_fault = t_fault
+        events.event("serve_engine_failover",
+                     error=f"{type(cause).__name__}: {cause}"[:300],
+                     resumed=resumed,
+                     quarantined=self.stats["failover_quarantined"],
+                     streak=self._failover_streak)
+        self._metric("counter", "serving_failovers_total")
+        if resumed:
+            self._metric("counter", "serving_requests_resumed_total",
+                         resumed)
+        log.warning("serving failover %s (streak %s/%s): %s — %s "
+                    "request(s) re-admitted", self.stats["failovers"],
+                    self._failover_streak, budget, cause, resumed)
+        return True
+
+    def _detach_all(self) -> list[Request]:
+        """Pull every live request out of the queue and the slot table
+        into resumable snapshot form (state QUEUED, slot released,
+        chunk plan dropped — exactly the preemption-resume shape),
+        preserving FIFO seniority: slot occupants (admitted earliest)
+        first, then the queue in order. Shared by failover and
+        drain."""
+        with self._work:
+            queued = list(self._queue)
+            self._queue.clear()
+            occupants = []
+            for s, r in enumerate(self._slots):
+                if r is not None:
+                    occupants.append(r)
+                    self._slots[s] = None
+            self._work.notify_all()
+        live: list[Request] = []
+        now = time.time()
+        for r in sorted(occupants, key=lambda r: (r.t_admit or 0.0, r.id)):
+            slot, r.slot = r.slot, None
+            self._release_slot(slot)
+            if r.state in (DONE, FAILED):
+                continue
+            r.state = QUEUED
+            r.chunk_plan = None
+            r._block_stalled = False
+            self._end_block_stall(r, time.perf_counter())
+            r.t_enqueue = now
+            live.append(r)
+        for r in queued:
+            if r.state not in (DONE, FAILED):
+                live.append(r)
+        return live
 
     def _fail_pending(self, err: EngineStopped):
         with self._work:
@@ -1989,6 +2516,7 @@ class GenerationEngine:
                 "kv_pool_device_bytes": self.kv_pool_device_bytes,
                 **dict(self.stats),
             }
+            snap["failover"] = dict(self._failover_info)
         ps = getattr(self.backend, "prefix_stats", None)
         if callable(ps):
             st = ps()

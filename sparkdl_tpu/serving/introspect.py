@@ -55,6 +55,7 @@ def engine_debug_state(eng) -> dict:
         running = eng._thread is not None
         fatal = eng._fatal
         stats = dict(eng.stats)
+        failover = dict(getattr(eng, "_failover_info", {}) or {})
     mgr = getattr(eng.backend, "mgr", None)
     slot_rows = []
     for i, r in enumerate(slots):
@@ -70,6 +71,12 @@ def engine_debug_state(eng) -> dict:
                 "age_s": round(now - (r.t_admit or now), 3),
                 "preemptions": r.preemptions,
                 "block_stalled": bool(r._block_stalled),
+                # ISSUE 19 exactly-once audit fields: the delivery
+                # cursor (tokens streamed to the client — must equal
+                # tokens_out at every boundary) and how many failovers
+                # this request has personally ridden through.
+                "delivered": r.delivered,
+                "failovers": r.failovers,
             })
             if r.chunk_plan is not None:
                 row["chunks_done"] = r.next_chunk
@@ -108,6 +115,11 @@ def engine_debug_state(eng) -> dict:
         },
         "slots": slot_rows,
         "stats": stats,
+        # ISSUE 19 survivability view: failover state machine (healthy /
+        # recovered / rebuild_failed / exhausted), counts, last cause,
+        # resumed/quarantined ledgers, backoff and fault-to-first-
+        # resumed-token recovery latency.
+        "failover": failover,
     }
     if eng.paged:
         pool = getattr(eng.backend, "pool_stats", None)

@@ -518,3 +518,74 @@ class TestDecimateKind:
         assert not plan._slot_decimated()
         plan._mark_decimated()  # no-op, must not raise
         assert not plan._slot_decimated()
+
+
+class TestServingSites:
+    """ISSUE 19: chaos grows serving sites (serve_prefill / serve_decode /
+    serve_alloc / serve_commit) + the `cache_lost` kind, so the engine's
+    failover seam is exercised by the same deterministic plan machinery
+    that drives training chaos. Engine-level behavioral coverage lives in
+    test_serving.py and scripts/serve_chaos_smoke.py."""
+
+    def test_serving_sites_and_cache_lost_validate(self):
+        for site in chaos.SERVING_SITES:
+            assert site in chaos.SITES
+            assert Fault(site, "cache_lost", at_step=1).site == site
+        # cache_lost models a donated-slot-cache loss: serving-only
+        with pytest.raises(ValueError, match="cache_lost"):
+            Fault("step_start", "cache_lost", at_step=1)
+        # env transport round-trips the new site/kind
+        f = Fault("serve_decode", "cache_lost", at_step=3, once=False)
+        back = FaultPlan.from_env(FaultPlan([f]).to_env())
+        assert back.faults == [f]
+
+    def test_injected_cache_lost_is_serving_fatal_and_retryable(self):
+        """The injected error carries the `serving_fatal` routing attr
+        (engine fails over instead of retrying the slot call) but
+        classifies retryable for the cluster supervisor — lost backend
+        state is recoverable by a rebuild, same verdict as the organic
+        SlotCacheLost."""
+        exc = chaos.InjectedCacheLost("injected slot-cache loss")
+        assert getattr(exc, "serving_fatal", False)
+        assert isinstance(exc, chaos.InjectedFault)
+        assert classify_exception(exc) == "retryable"
+
+    def test_engine_fails_over_under_cache_lost_plan(self):
+        """An installed plan firing cache_lost at a serve_decode call
+        must push the engine through a full failover (backend rebuild +
+        re-admission) and still complete every request with exactly-once
+        delivery."""
+        from sparkdl_tpu.serving import GenerationEngine, StubBackend
+
+        # prob=1.0 + once: fire on the FIRST decode call, whatever the
+        # global backend-call index it lands on (the step counter is
+        # shared across serving sites).
+        chaos.install(FaultPlan([Fault("serve_decode", "cache_lost",
+                                       prob=1.0)]))
+        eng = GenerationEngine(StubBackend(2, 64, vocab_size=997),
+                               retries=1)
+        reqs = [eng.submit([7 * (i + 1)], max_new_tokens=5)
+                for i in range(2)]
+        for _ in range(200):
+            if not eng.step():
+                break
+        assert all(r.state == "done" for r in reqs)
+        assert eng.stats["failovers"] == 1
+        assert eng.stats["failover_resumed"] == 2
+        assert eng._failover_info["state"] == "recovered"
+        assert eng._failover_info["last_cause"].startswith(
+            "InjectedCacheLost")
+        for r in reqs:
+            assert r.delivered == len(r.tokens) == 5
+        # token identity vs an uninjected run: exactly-once resume means
+        # chaos must be invisible in the output stream
+        chaos.uninstall()
+        clean = GenerationEngine(StubBackend(2, 64, vocab_size=997),
+                                 retries=1)
+        creqs = [clean.submit([7 * (i + 1)], max_new_tokens=5)
+                 for i in range(2)]
+        for _ in range(200):
+            if not clean.step():
+                break
+        for r, c in zip(reqs, creqs):
+            assert r.tokens == c.tokens

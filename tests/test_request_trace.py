@@ -623,6 +623,50 @@ class TestReportClis:
         assert head["serve_trace_max_unattributed_frac"] == \
             leg["trace_attribution"]["max_unattributed_frac"]
 
+    def test_serve_bench_survivability_leg_and_gating(self):
+        """ISSUE 19 satellite: the survivability leg reports one
+        injected failover's recovery latency + the exactly-once
+        token-identity float, _serve_headline forwards both (riding
+        healthy AND backend_unavailable records), and bench_trend's
+        name-shape rules gate them in the right direction."""
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "serve_bench",
+            os.path.join(_REPO, "scripts", "serve_bench.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        surv = mod.run_survivability_comparison(n_requests=8,
+                                                concurrency=4)
+        assert surv["failovers"] == 1
+        assert surv["token_identical"] == 1.0  # float, NOT bool
+        assert not isinstance(surv["token_identical"], bool)
+        assert surv["recovery_s"] is not None and surv["recovery_s"] > 0
+        assert surv["clean"]["completed"] == 8
+        assert surv["faulted"]["completed"] == 8
+        sys.path.insert(0, _REPO)
+        import bench
+        head = bench._serve_headline({"survivability": surv})
+        assert head["serve_recovery_s"] == surv["recovery_s"]
+        assert head["serve_failover_token_identical"] == 1.0
+        bt_spec = importlib.util.spec_from_file_location(
+            "bench_trend",
+            os.path.join(_REPO, "scripts", "bench_trend.py"))
+        bt = importlib.util.module_from_spec(bt_spec)
+        bt_spec.loader.exec_module(bt)
+        assert bt._LOWER_IS_BETTER.search("serve_recovery_s")
+        assert not bt._LOWER_IS_BETTER.search(
+            "serve_failover_token_identical")
+        # a slower recovery OR a broken identity must trip the gate
+        recs = [{"n": i, "parsed": {"metric": "m", "value": 1.0,
+                                    "extra": e}}
+                for i, e in ((1, {"serve_recovery_s": 0.05,
+                                  "serve_failover_token_identical": 1.0}),
+                             (2, {"serve_recovery_s": 0.12,
+                                  "serve_failover_token_identical": 0.0}))]
+        rep = bt.trend(recs)
+        assert {"serve_recovery_s", "serve_failover_token_identical"} \
+            <= set(rep["regressions"])
+
     def test_gang_aggregation_merges_trace_blocks(self, tmp_path):
         """aggregate_snapshots re-ranks the per-rank slowest lists into
         one gang tail."""
@@ -679,3 +723,24 @@ class TestEngineInspectorIntegrity:
         assert snap["n_engines"] >= 1
         assert all("slots" in e or "error" in e
                    for e in snap["engines"])
+
+    def test_debug_state_exposes_failover_and_delivery_cursors(self):
+        """ISSUE 19: the /serving view carries the failover state
+        machine block, and each occupied slot row shows the exactly-once
+        audit fields (delivery cursor + per-request failover count)."""
+        eng = GenerationEngine(StubBackend(1, 32, vocab_size=997))
+        eng.submit([5], max_new_tokens=8)
+        for _ in range(3):
+            eng.step()
+        state = introspect.engine_debug_state(eng)
+        fo = state["failover"]
+        assert fo["state"] == "healthy"
+        assert fo["count"] == 0 and fo["quarantined_total"] == 0
+        row = state["slots"][0]
+        assert row["state"] == "running"
+        # the delivery cursor must sit exactly at the emitted frontier
+        # at every iteration boundary — that equality IS exactly-once
+        assert row["delivered"] == row["tokens_out"] > 0
+        assert row["failovers"] == 0
+        # snapshot() (the aggregate-counters view) carries it too
+        assert eng.snapshot()["failover"]["state"] == "healthy"

@@ -10,6 +10,9 @@ token-identical to the static ``generate()`` path) and the slow
 serve-smoke e2e.
 """
 
+import glob
+import os
+import re
 import threading
 import time
 
@@ -17,8 +20,9 @@ import numpy as np
 import pytest
 
 from sparkdl_tpu.runner import telemetry
-from sparkdl_tpu.serving import (EngineStopped, GenerationEngine,
-                                 QueueFullError, RequestQuarantined,
+from sparkdl_tpu.serving import (DeadlineExceeded, EngineStopped,
+                                 GenerationEngine, QueueFullError,
+                                 RequestCancelled, RequestQuarantined,
                                  RequestRejected, ServingStallError,
                                  StubBackend, bucket_length)
 
@@ -250,12 +254,62 @@ class TestScheduler:
     def test_serving_fatal_error_skips_retry_and_fails_over(self):
         """An error flagged ``serving_fatal`` (backend.SlotCacheLost:
         the donated cache was consumed — retrying would read a deleted
-        buffer) must fail the engine over immediately: no retry burned,
-        no innocent requests evicted one by one."""
+        buffer) skips the retry/evict ladder entirely and routes
+        through the ISSUE 19 failover seam: the backend is rebuilt and
+        every live request re-admitted via the preemption-resume path.
+        A decode-only fault still gains one token per cycle (the resume
+        prefill emits the next token), so no budget trips and the
+        workload COMPLETES — token-identical to a clean run."""
         class CacheGone(RuntimeError):
             serving_fatal = True
 
         class LostCache(StubBackend):
+            def __init__(self, *a, **kw):
+                super().__init__(*a, **kw)
+                self.rebuilds = 0
+
+            def step(self, active):
+                raise CacheGone("cache consumed mid-execution")
+
+            def rebuild(self):
+                self.rebuilds += 1
+                super().rebuild()
+
+        be = LostCache(2, 64, vocab_size=100)
+        # budget = 2 chunks so BOTH requests prefill (and so progress)
+        # every failover cycle
+        eng = GenerationEngine(be, retries=3, prefill_chunk=8,
+                               prefill_budget=16)
+        a = eng.submit([1], max_new_tokens=3)
+        b = eng.submit([2], max_new_tokens=3)
+        eng.run_until_idle()
+        snap = eng.snapshot()
+        # no retries burned, nobody evicted/quarantined — straight over
+        assert snap["step_retries"] == 0 and snap["quarantined"] == 0
+        assert snap["failovers"] >= 2 and be.rebuilds == snap["failovers"]
+        assert snap["failover"]["state"] == "recovered"
+        assert snap["failover_resumed"] >= 2
+        for r in (a, b):
+            assert len(r.result(1)) == 3 and r.finish_reason == "length"
+            assert r.failovers > 0 and r.delivered == 3
+        # exactly-once resume: the interrupted run's streams are
+        # bit-identical to an uninterrupted engine's
+        eng2 = GenerationEngine(StubBackend(2, 64, vocab_size=100))
+        a2 = eng2.submit([1], max_new_tokens=3)
+        b2 = eng2.submit([2], max_new_tokens=3)
+        eng2.run_until_idle()
+        assert a.tokens == a2.tokens and b.tokens == b2.tokens
+
+    def test_fatal_error_without_rebuild_fails_closed(self):
+        """A backend with no ``rebuild`` hook keeps the pre-ISSUE-19
+        posture: serving-fatal ⇒ engine dies, pending requests failed
+        with EngineStopped, later submits rejected."""
+        class CacheGone(RuntimeError):
+            serving_fatal = True
+
+        class LostCache(StubBackend):
+            rebuild = None  # not failover-capable
+
             def step(self, active):
                 raise CacheGone("cache consumed mid-execution")
 
@@ -267,6 +321,7 @@ class TestScheduler:
             eng.run_until_idle()
         snap = eng.snapshot()
         assert snap["step_retries"] == 0 and snap["quarantined"] == 0
+        assert snap["failovers"] == 0
         for r in (a, b):
             assert r.state == "failed" and \
                 isinstance(r.error, EngineStopped)
@@ -275,6 +330,8 @@ class TestScheduler:
 
     def test_stall_watchdog_names_stage_and_fails_pending(self):
         class Wedged(StubBackend):
+            rebuild = None  # not failover-capable: fail closed
+
             def step(self, active):
                 time.sleep(3)
                 return super().step(active)
@@ -284,6 +341,88 @@ class TestScheduler:
         with pytest.raises(ServingStallError, match="decode_step"):
             eng.run_until_idle()
         assert r.state == "failed" and isinstance(r.error, EngineStopped)
+
+    def test_stall_fails_over_when_backend_is_rebuildable(self):
+        """A stall-watchdog fire on a rebuildable backend is a failover
+        cause, not a death sentence: the wedged call is abandoned (the
+        watchdog pool is discarded so the rebuild never queues behind
+        it) and the workload completes after the rebuild."""
+        class WedgedOnce(StubBackend):
+            def __init__(self, *a, **kw):
+                super().__init__(*a, **kw)
+                self.wedged = False
+
+            def step(self, active):
+                if not self.wedged:
+                    self.wedged = True
+                    time.sleep(0.8)
+                    # late return from the abandoned stint: report
+                    # nothing, touch no chain state — the engine
+                    # discarded this future anyway
+                    return [0] * self.num_slots
+                return super().step(active)
+
+        eng = GenerationEngine(WedgedOnce(1, 64, vocab_size=100),
+                               stall_s=0.1)
+        r = eng.submit([1], max_new_tokens=4)
+        eng.run_until_idle()
+        snap = eng.snapshot()
+        assert snap["failovers"] == 1
+        assert snap["failover"]["state"] == "recovered"
+        assert len(r.result(1)) == 4 and r.failovers == 1
+
+    def test_failover_budget_exhaustion_fails_closed_classified(self):
+        """Zero-progress failovers (the fault hits before ANY token)
+        burn the engine streak; past SPARKDL_SERVE_FAILOVER_BUDGET the
+        engine fails closed with the budget named in the error."""
+        class CacheGone(RuntimeError):
+            serving_fatal = True
+
+        class DeadOnArrival(StubBackend):
+            def finish_prefill(self, *a, **kw):
+                raise CacheGone("cache consumed mid-prefill")
+
+        eng = GenerationEngine(DeadOnArrival(1, 64, vocab_size=100),
+                               failover_budget=2)
+        r = eng.submit([1], max_new_tokens=4)
+        with pytest.raises(CacheGone):
+            eng.run_until_idle()
+        snap = eng.snapshot()
+        assert snap["failovers"] == 2  # budget spent before the trip
+        assert snap["failover"]["state"] == "exhausted"
+        assert r.state == "failed" and isinstance(r.error, EngineStopped)
+        assert "failover budget exhausted" in str(r.error)
+        assert "SPARKDL_SERVE_FAILOVER_BUDGET=2" in str(r.error)
+
+    def test_per_request_failover_quarantine_spares_the_fleet(self):
+        """A single request that personally triggers the fault (and so
+        never gains a token across failovers) is quarantined
+        individually; innocent co-resident requests keep completing —
+        and the engine survives, because the poison request's removal
+        restores progress."""
+        class CacheGone(RuntimeError):
+            serving_fatal = True
+
+        class PoisonPrompt(StubBackend):
+            def finish_prefill(self, slot, prompt, last_tok,
+                               aligned_len, commit=True):
+                if list(prompt)[:1] == [99]:
+                    raise CacheGone("poison prompt")
+                return super().finish_prefill(slot, prompt, last_tok,
+                                              aligned_len, commit=commit)
+
+        eng = GenerationEngine(PoisonPrompt(2, 64, vocab_size=100),
+                               failover_budget=2, prefill_chunk=8,
+                               prefill_budget=16)
+        good = eng.submit([1], max_new_tokens=3)
+        bad = eng.submit([99], max_new_tokens=3)
+        eng.run_until_idle()
+        assert len(good.result(1)) == 3
+        assert bad.state == "failed" and \
+            isinstance(bad.error, RequestQuarantined)
+        snap = eng.snapshot()
+        assert snap["failover_quarantined"] == 1
+        assert snap["failover"]["quarantined_total"] == 1
 
     def test_stop_now_fails_pending_drain_completes(self):
         eng = GenerationEngine(StubBackend(1, 64, vocab_size=100,
@@ -337,6 +476,265 @@ class TestScheduler:
         assert bucket_length(33, 8) == 64
         with pytest.raises(ValueError):
             bucket_length(0, 8)
+
+
+# ---------------------------------------------------------------------------
+# deadlines + cancellation (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+class TestDeadlinesAndCancel:
+    def test_cancel_running_prefilling_and_queued(self):
+        """``Request.cancel()`` is honored at the next iteration
+        boundary in every live state — RUNNING, PREFILLING (multi-chunk
+        prompt), and still-queued — freeing the slot each time, and a
+        cancelled request is counted ``cancelled``, never
+        ``quarantined``."""
+        eng = GenerationEngine(StubBackend(1, 64, vocab_size=100),
+                               prefill_chunk=4)
+        running = eng.submit([1, 2, 3], max_new_tokens=50)
+        prefilling = eng.submit(list(range(16)), max_new_tokens=5)
+        queued = eng.submit([7], max_new_tokens=5)
+        for _ in range(20):
+            eng.step()
+            if running.state == "running":
+                break
+        assert running.state == "running"
+        running.cancel()
+        eng.step()  # boundary reap frees the only slot
+        assert running.state == "failed"
+        assert running.finish_reason == "cancelled"
+        assert isinstance(running.error, RequestCancelled)
+        # the 16-token prompt admits into the freed slot: 4 chunks, so
+        # after one step it is mid-prefill
+        for _ in range(20):
+            if prefilling.state == "prefilling":
+                break
+            eng.step()
+        assert prefilling.state == "prefilling"
+        prefilling.cancel()
+        queued.cancel()  # cancelled straight out of the queue
+        eng.step()  # one boundary reaps both (before any admission)
+        assert prefilling.state == "failed" and \
+            prefilling.finish_reason == "cancelled"
+        assert queued.state == "failed" and queued.t_admit is None
+        snap = eng.snapshot()
+        assert snap["cancelled"] == 3 and snap["quarantined"] == 0
+        assert snap["failover_quarantined"] == 0
+        after = eng.submit([5], max_new_tokens=3)  # engine healthy
+        eng.run_until_idle()
+        assert len(after.result(1)) == 3
+
+    def test_deadline_mid_chunked_prefill_releases_blocks_and_radix(self):
+        """A deadline expiring mid-chunked-prefill releases every
+        reserved KV block and leaves NO radix entry (the commit only
+        happens at finish_prefill, which the victim never reaches)."""
+        be = StubBackend(2, 64, vocab_size=100, block_size=4,
+                         prefix_cache_bytes=1 << 20)
+        eng = GenerationEngine(be, prefill_chunk=4)
+        free0 = be.pool_stats()["blocks_free"]
+        r = eng.submit(list(range(1, 17)), max_new_tokens=5,
+                       deadline_s=0.05)
+        eng.step()  # admit + reserve blocks + chunk 1 of 4
+        assert r.state == "prefilling"
+        assert be.pool_stats()["blocks_free"] < free0
+        time.sleep(0.06)
+        eng.step()  # boundary reap: slot + blocks released
+        assert r.state == "failed" and r.finish_reason == "deadline"
+        assert isinstance(r.error, DeadlineExceeded)
+        assert be.pool_stats()["blocks_free"] == free0
+        assert be.pool_stats()["radix_blocks"] == 0  # no commit rolled in
+        snap = eng.snapshot()
+        assert snap["cancelled"] == 1 and snap["quarantined"] == 0
+
+    def test_deadline_env_default_applies(self, monkeypatch):
+        monkeypatch.setenv("SPARKDL_SERVE_DEADLINE_S", "0.03")
+        eng = GenerationEngine(StubBackend(1, 64, vocab_size=100,
+                                           step_s=0.01))
+        assert eng.default_deadline_s == pytest.approx(0.03)
+        r = eng.submit([1], max_new_tokens=50)
+        eng.run_until_idle()
+        assert r.finish_reason == "deadline"
+        assert isinstance(r.error, DeadlineExceeded)
+        assert 0 < len(r.tokens) < 50
+
+    def test_deadline_honored_mid_verify_window(self):
+        """Speculation can emit several tokens per iteration; the emit
+        loop re-checks the deadline BETWEEN window tokens, so an expiry
+        mid-verify-window stops the stream exactly at the cut."""
+        cut = 10
+
+        def cb(req, tok):
+            if len(req.tokens) == cut:
+                req.t_deadline = time.time() - 1.0  # already expired
+
+        eng = GenerationEngine(StubBackend(2, 64, vocab_size=8),
+                               spec_k=4)
+        h = eng.submit([1, 2, 3], max_new_tokens=40, stream_cb=cb)
+        eng.run_until_idle()
+        assert eng.snapshot()["spec_verifies"] >= 1  # speculation ran
+        assert h.state == "failed" and h.finish_reason == "deadline"
+        assert isinstance(h.error, DeadlineExceeded)
+        assert len(h.tokens) == cut and h.delivered == cut
+
+    def test_cancel_honored_mid_verify_window(self):
+        cut = 8
+
+        def cb(req, tok):
+            if len(req.tokens) == cut:
+                req.cancel()
+
+        eng = GenerationEngine(StubBackend(2, 64, vocab_size=8),
+                               spec_k=4)
+        h = eng.submit([1, 2, 3], max_new_tokens=40, stream_cb=cb)
+        eng.run_until_idle()
+        assert h.state == "failed" and h.finish_reason == "cancelled"
+        assert isinstance(h.error, RequestCancelled)
+        assert len(h.tokens) == cut and h.delivered == cut
+        assert eng.snapshot()["quarantined"] == 0
+
+
+# ---------------------------------------------------------------------------
+# serving failure taxonomy drift-guard (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+class TestFailureTaxonomy:
+    """Every exception class defined under ``sparkdl_tpu/serving/``
+    must carry an explicit verdict in
+    ``runner.failures.SERVING_CLASS_VERDICTS`` — the same static
+    drift-guard posture as ``check_env_docs``, so failover vs retry vs
+    quarantine routing can never silently default for a new error.
+    Text-based (not import-based): ``serving/backend.py`` imports jax
+    at module scope, and this guard must hold in any environment."""
+
+    _CLASS_RE = re.compile(r"^class\s+(\w+)\(([^)]*)\):", re.MULTILINE)
+    _BUILTIN_EXC = {"BaseException", "Exception", "RuntimeError",
+                    "ValueError", "KeyError", "OSError", "TimeoutError"}
+
+    def _serving_exception_classes(self) -> set:
+        root = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "sparkdl_tpu", "serving")
+        bases_of: dict = {}
+        for path in glob.glob(os.path.join(root, "*.py")):
+            with open(path, encoding="utf-8") as f:
+                for name, bases in self._CLASS_RE.findall(f.read()):
+                    bases_of[name] = [b.strip().split(".")[-1]
+                                      for b in bases.split(",")
+                                      if b.strip()]
+        exc: set = set()
+        changed = True
+        while changed:  # transitive: FooError(ServingError) counts too
+            changed = False
+            for name, bases in bases_of.items():
+                if name not in exc and any(
+                        b in self._BUILTIN_EXC or b in exc
+                        for b in bases):
+                    exc.add(name)
+                    changed = True
+        return exc
+
+    def test_every_serving_exception_has_a_verdict(self):
+        from sparkdl_tpu.runner import failures
+        classes = self._serving_exception_classes()
+        # the grep itself works (engine + backend exceptions found)
+        assert "ServingError" in classes and "SlotCacheLost" in classes
+        assert "BlockExhausted" in classes
+        missing = sorted(c for c in classes
+                         if c not in failures.SERVING_CLASS_VERDICTS)
+        assert not missing, (
+            f"serving exception classes without a "
+            f"failures.SERVING_CLASS_VERDICTS entry: {missing}")
+        for name in classes:
+            assert failures.SERVING_CLASS_VERDICTS[name] in (
+                "retryable", "fatal")
+
+    def test_classify_routes_serving_exceptions(self):
+        from sparkdl_tpu.runner import failures
+        from sparkdl_tpu.runner.chaos import InjectedCacheLost
+        from sparkdl_tpu.serving import engine as E
+        assert failures.classify_exception(
+            E.RequestQuarantined("x")) == "fatal"
+        assert failures.classify_exception(
+            E.EngineStopped("x")) == "retryable"
+        assert failures.classify_exception(
+            E.DeadlineExceeded("x")) == "fatal"
+        assert failures.classify_exception(
+            E.RequestCancelled("x")) == "fatal"
+        assert failures.classify_exception(
+            E.QueueFullError("x")) == "retryable"
+        assert failures.classify_exception(
+            InjectedCacheLost("injected slot-cache loss")) == "retryable"
+
+        # subclasses inherit via the MRO walk — an ad-hoc subclass of a
+        # mapped class needs no entry of its own
+        class Custom(E.ServingStallError):
+            pass
+
+        assert failures.classify_exception(Custom("y")) == "retryable"
+        # text classification (a dead replica's stderr) agrees
+        assert failures.classify_text(
+            "RequestQuarantined: poisoned request") == "fatal"
+        assert failures.classify_text(
+            "EngineStopped: engine died") == "retryable"
+
+
+# ---------------------------------------------------------------------------
+# graceful drain + resume (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+class TestDrainAndResume:
+    def test_drain_returns_resumable_snapshots_token_identical(self):
+        """drain() mid-run returns live requests as preemption-resume
+        snapshots; feeding them to resume() on a FRESH engine continues
+        each stream exactly where it left off — the concatenation is
+        bit-identical to an uninterrupted run, nothing re-emitted."""
+        eng = GenerationEngine(StubBackend(2, 64, vocab_size=997,
+                                           step_s=0.005)).start()
+        rs = [eng.submit([i + 1, 5], max_new_tokens=12) for i in range(3)]
+        for _ in range(400):  # let some tokens stream first
+            if sum(len(r.tokens) for r in rs) >= 4:
+                break
+            time.sleep(0.005)
+        snaps = eng.drain(timeout=5)
+        assert snaps, "expected live requests at drain time"
+        already = {r.id: list(r.tokens) for r in rs}
+        eng2 = GenerationEngine(StubBackend(2, 64, vocab_size=997))
+        for s in snaps:
+            assert s.state == "queued" and s.slot is None
+            eng2.resume(s)
+        eng2.run_until_idle()
+        clean = GenerationEngine(StubBackend(2, 64, vocab_size=997))
+        expect = [clean.submit([i + 1, 5], max_new_tokens=12)
+                  for i in range(3)]
+        clean.run_until_idle()
+        for r, e in zip(rs, expect):
+            assert len(r.result(5)) == 12
+            assert r.tokens == e.tokens  # identical across the handoff
+            assert r.tokens[:len(already[r.id])] == already[r.id]
+            assert r.delivered == 12
+        with pytest.raises(EngineStopped):
+            eng.submit([9], max_new_tokens=2)  # drained engine is closed
+
+    def test_stop_drain_true_shares_drain_path(self):
+        eng = GenerationEngine(StubBackend(1, 64, vocab_size=100)).start()
+        rs = [eng.submit([i + 1], max_new_tokens=3) for i in range(3)]
+        out = eng.stop(drain=True, timeout=30)
+        assert out == []  # clean drain: everything finished, no snaps
+        assert all(r.state == "done" for r in rs)
+
+    def test_overlong_drain_degrades_to_snapshot_and_stop(self):
+        """A drain that cannot finish inside its budget (here: a
+        workload worth ~50s of decode against a 0.5s timeout) degrades
+        to snapshot-and-stop instead of hanging the caller — the
+        still-live requests come back as resumable snapshots."""
+        eng = GenerationEngine(StubBackend(1, 2048, vocab_size=100,
+                                           step_s=0.05)).start()
+        r = eng.submit([1], max_new_tokens=1000)
+        assert r.wait(0.001) is False
+        t0 = time.time()
+        snaps = eng.stop(drain=True, timeout=0.5)
+        assert time.time() - t0 < 10  # never hung on the drain
+        assert any(s is r for s in snaps)
+        assert r.state == "queued"  # resumable, not failed
 
 
 # ---------------------------------------------------------------------------
@@ -943,6 +1341,24 @@ def test_serve_smoke_end_to_end():
     spec = importlib.util.spec_from_file_location(
         "serve_smoke", os.path.join(os.path.dirname(__file__), "..",
                                     "scripts", "serve_smoke.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main() == 0
+
+
+@pytest.mark.slow
+def test_serve_chaos_smoke_end_to_end():
+    """ISSUE 19 survivability evidence: injected cache_lost at
+    serve_decode + serve_alloc across Stub/Llama x unpaged/paged,
+    token-identical failover with a zero-dup/zero-loss stream ledger,
+    the budget counterfactual failing closed classified, drain/resume
+    identity, and the three-way quarantine ledger agreement
+    (scripts/serve_chaos_smoke.py, in-process)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "serve_chaos_smoke", os.path.join(
+            os.path.dirname(__file__), "..", "scripts",
+            "serve_chaos_smoke.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     assert mod.main() == 0
