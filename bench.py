@@ -1269,6 +1269,18 @@ def _serve_headline(serve: dict) -> dict:
         out["serve_recovery_s"] = surv["recovery_s"]
     if surv.get("token_identical") is not None:
         out["serve_failover_token_identical"] = surv["token_identical"]
+    # ISSUE 20: fleet headline — kill-to-first-re-admitted-token latency
+    # and the cross-replica exactly-once gate (same float convention as
+    # the engine-level pair above), plus the radix-vs-round-robin
+    # fleet-wide prefix reuse ratio. Stub leg, rides healthy AND
+    # backend_unavailable records.
+    flt = serve.get("fleet") or {}
+    if flt.get("recovery_s") is not None:
+        out["fleet_recovery_s"] = flt["recovery_s"]
+    if flt.get("token_identical") is not None:
+        out["fleet_token_identical"] = flt["token_identical"]
+    if flt.get("reuse_ratio") is not None:
+        out["fleet_prefix_reuse_ratio"] = flt["reuse_ratio"]
     # ISSUE 14: tensor-parallel headline — greedy identity across the
     # tp degrees, per-device KV pool bytes (the 1/tp shrink), and
     # zero-re-trace evidence, from the 8-virtual-device subprocess leg

@@ -1,0 +1,274 @@
+#!/usr/bin/env python
+"""Serving fleet chaos smoke (ISSUE 20 tentpole evidence).
+
+Four backend shapes — Stub/Llama x unpaged/paged, all on CPU — each
+driven as a ≥3-replica :class:`EngineFleet` through a concurrent
+request mix that survives BOTH fleet failure modes in one run:
+
+1. **Unclean replica death** — a ``replica_dead`` fault injected at the
+   ``fleet_route`` chaos site kills the chosen replica with NO drain
+   mid-stream; the router re-admits its in-flight requests from its own
+   shadow state (prompt + fleet delivery cursor).
+2. **DOOMED drain-and-re-admit** — a second replica is doomed while
+   serving; its ``engine.drain()`` snapshots resume on the survivor.
+
+The surviving output must be **token-identical to a clean
+single-engine run** with **zero duplicated and zero lost streamed
+tokens** (the delivery-cursor audit: ``streamed == request.tokens`` and
+``delivered == len(tokens)`` for every request).
+
+Fleet-policy legs (backend-independent, run on the stub):
+
+3. **Min-replicas counterfactual** — with
+   ``SPARKDL_FLEET_MIN_REPLICAS=2`` and one replica dead, the fleet
+   fails CLOSED: ``submit`` raises one classified
+   ``FleetDegradedError`` naming the knob; ``classify_exception`` and
+   ``classify_text`` both call it retryable.
+4. **Radix vs round-robin** — the same prefix-family workload through a
+   radix-routed fleet and a round-robin fleet: the radix router must
+   beat round-robin on fleet-wide prefix reuse (co-location keeps each
+   family's head resident on ONE replica instead of re-prefilling it
+   everywhere).
+
+Prints one JSON line and exits 0 on success.
+
+Run: ``JAX_PLATFORMS=cpu python scripts/fleet_chaos_smoke.py``
+(``SERVE_CHAOS_SKIP_LLAMA=1`` limits to the stub shapes.)
+"""
+
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+VOCAB = 997  # prime vocab: the stub's fold-chain stream is a real oracle
+N_REPLICAS = 3
+
+
+def _workload(rng, vocab: int, n: int, max_new=(6, 8, 10)):
+    return [(rng.randint(1, vocab, size=int(rng.choice((2, 4, 7))))
+             .tolist(), int(rng.choice(max_new))) for _ in range(n)]
+
+
+def _clean_reference(make_engine, workload):
+    """Ground truth: the whole workload on ONE uninterrupted engine."""
+    eng = make_engine()
+    reqs = [eng.submit(p, max_new_tokens=n, block=False)
+            for p, n in workload]
+    eng.run_until_idle()
+    assert all(r.state == "done" for r in reqs), \
+        [(r.id, r.state, str(r.error)[:80]) for r in reqs]
+    return [list(r.tokens) for r in reqs]
+
+
+def _audit_exactly_once(frs, streams):
+    for fr in frs:
+        if streams.get(fr.id, []) != fr.tokens:
+            return False, (f"request {fr.id}: streamed "
+                           f"{streams.get(fr.id)} != tokens {fr.tokens}")
+        if fr.delivered != len(fr.tokens):
+            return False, (f"request {fr.id}: delivered={fr.delivered} "
+                           f"!= {len(fr.tokens)} tokens")
+    return True, None
+
+
+def fleet_survival_leg(name, make_engine, workload) -> dict:
+    """Legs 1+2 for one backend shape: one unclean ``replica_dead``
+    (chaos-injected at ``fleet_route``) plus one router-doomed
+    drain-and-re-admit, under a concurrent mix, finishing
+    token-identical to the clean single-engine reference."""
+    from sparkdl_tpu.runner import chaos
+    from sparkdl_tpu.runner.chaos import Fault, FaultPlan
+    from sparkdl_tpu.serving import DEAD, EngineFleet
+
+    clean = _clean_reference(make_engine, workload)
+
+    chaos.uninstall()
+    fleet = EngineFleet([make_engine() for _ in range(N_REPLICAS)])
+    streams = {}
+
+    def cb(fr, tok):
+        streams.setdefault(fr.id, []).append(tok)
+
+    # the 4th routing decision's chosen replica dies UNCLEANLY — by
+    # then the first three requests are mid-stream (stepped below), so
+    # shadow re-admission must carry live delivery cursors
+    chaos.install(FaultPlan([Fault("fleet_route", "replica_dead",
+                                   at_step=4)]))
+    try:
+        frs = [fleet.submit(p, max_new_tokens=n, stream_cb=cb)
+               for p, n in workload[:3]]
+        for _ in range(3):
+            fleet.step()
+        assert any(fr.delivered for fr in frs), \
+            f"[{name}] no tokens streamed before the injected death"
+        frs += [fleet.submit(p, max_new_tokens=n, stream_cb=cb)
+                for p, n in workload[3:]]
+    finally:
+        chaos.uninstall()
+    deaths = fleet.stats["replica_deaths"]
+    assert deaths == 1, f"[{name}] injected replica_dead did not fire"
+
+    # now DOOM a second replica that is actively serving: drain + resume
+    for _ in range(2):
+        fleet.step()
+    victim = next(fr.replica for fr in frs
+                  if not fr.done and fr.replica is not None
+                  and fleet.replica_state(fr.replica) != DEAD)
+    fleet.doom_replica(victim, "smoke: doomed while serving")
+    fleet.run_until_idle()
+
+    assert all(fr.state == "done" for fr in frs), \
+        f"[{name}] fleet run did not complete: " \
+        f"{[(fr.id, fr.state, str(fr.error)[:80]) for fr in frs]}"
+    identical = all(fr.tokens == c for fr, c in zip(frs, clean))
+    assert identical, f"[{name}] not token-identical to the clean " + \
+        f"single-engine run: " + str(
+            [(fr.tokens, c) for fr, c in zip(frs, clean)
+             if fr.tokens != c][:2])
+    ok, why = _audit_exactly_once(frs, streams)
+    assert ok, f"[{name}] exactly-once audit failed: {why}"
+    assert fleet.stats["readmissions"] >= 1, fleet.stats
+    assert fleet.stats["drains"] >= 1, fleet.stats
+    hops = sum(fr.hops for fr in frs)
+    assert hops >= 1, "no request actually hopped replicas"
+    return {"requests": len(frs), "replica_deaths": deaths,
+            "drains": fleet.stats["drains"],
+            "readmissions": fleet.stats["readmissions"],
+            "hops": hops, "token_identical": identical}
+
+
+def min_replicas_counterfactual_leg() -> dict:
+    """Leg 3: below the SPARKDL_FLEET_MIN_REPLICAS floor the fleet
+    fails CLOSED with one classified error naming the knob."""
+    from sparkdl_tpu.runner.failures import (classify_exception,
+                                             classify_text)
+    from sparkdl_tpu.serving import (EngineFleet, FleetDegradedError,
+                                     GenerationEngine, StubBackend)
+
+    os.environ["SPARKDL_FLEET_MIN_REPLICAS"] = "2"
+    try:
+        fleet = EngineFleet([
+            GenerationEngine(StubBackend(2, 64, vocab_size=VOCAB))
+            for _ in range(2)])
+        assert fleet.min_replicas == 2  # the env knob armed it
+        fleet.kill_replica(fleet.replica_names()[0])
+        err = None
+        try:
+            fleet.submit([1, 2, 3], max_new_tokens=4)
+        except FleetDegradedError as e:
+            err = e
+        assert err is not None, "sub-floor fleet accepted work"
+        assert "SPARKDL_FLEET_MIN_REPLICAS" in str(err), err
+        verdict = classify_exception(err)
+        text_verdict = classify_text(f"FleetDegradedError: {err}")
+        assert verdict == text_verdict == "retryable", \
+            (verdict, text_verdict)
+    finally:
+        del os.environ["SPARKDL_FLEET_MIN_REPLICAS"]
+    return {"error": type(err).__name__, "verdict": verdict,
+            "fails_closed": True}
+
+
+def radix_vs_round_robin_leg() -> dict:
+    """Leg 4: fleet-wide prefix reuse, radix-aware router vs the
+    round-robin comparator, on a prefix-family workload whose heads
+    partition cleanly across the replicas."""
+    import numpy as np
+
+    from sparkdl_tpu.serving import (EngineFleet, GenerationEngine,
+                                     StubBackend)
+
+    rng = np.random.RandomState(7)
+    families = [rng.randint(1, VOCAB, size=48).tolist() for _ in range(3)]
+    workload = []
+    # burst arrival (a session re-asking under one shared head): the
+    # radix router keeps each family resident on ONE replica while
+    # round-robin sprays the burst across all of them, re-prefilling
+    # the same head everywhere
+    for fi, head in enumerate(families):
+        for i in range(8):
+            workload.append((head + [500 + 10 * fi + i], 2))
+
+    def run(routing):
+        fleet = EngineFleet(
+            [GenerationEngine(StubBackend(
+                2, 96, vocab_size=VOCAB, prefix_cache_bytes=1 << 20))
+             for _ in range(N_REPLICAS)], routing=routing)
+        frs = [fleet.submit(p, max_new_tokens=n) for p, n in workload]
+        fleet.run_until_idle()
+        assert all(fr.state == "done" for fr in frs), routing
+        reused = sum(getattr(fr._primary, "prefill_reused", 0) or 0
+                     for fr in frs)
+        prompt_tokens = sum(len(p) for p, _ in workload)
+        return reused, round(reused / prompt_tokens, 4)
+
+    radix_reused, radix_rate = run("radix")
+    rr_reused, rr_rate = run("round_robin")
+    assert radix_reused > rr_reused, \
+        (f"radix router did not beat round-robin on fleet prefix "
+         f"reuse: {radix_reused} <= {rr_reused}")
+    return {"radix_reused_tokens": radix_reused,
+            "radix_hit_rate": radix_rate,
+            "round_robin_reused_tokens": rr_reused,
+            "round_robin_hit_rate": rr_rate,
+            "radix_beats_rr": True}
+
+
+def main() -> int:
+    import numpy as np
+
+    from sparkdl_tpu.serving import GenerationEngine, StubBackend
+
+    rng = np.random.RandomState(0)
+    out = {"legs": {}}
+
+    stub_load = _workload(rng, VOCAB, 8)
+    shapes = {
+        "stub": lambda: GenerationEngine(
+            StubBackend(2, 64, vocab_size=VOCAB), retries=1),
+        "stub_paged": lambda: GenerationEngine(
+            StubBackend(2, 64, vocab_size=VOCAB, block_size=8,
+                        prefix_cache_bytes=1 << 20), retries=1),
+    }
+    if os.environ.get("SERVE_CHAOS_SKIP_LLAMA", "") != "1":
+        import jax
+
+        from sparkdl_tpu.models import llama as L
+
+        cfg = L.LlamaConfig.tiny()
+        model = L.LlamaModel(cfg)
+        variables = model.init(jax.random.PRNGKey(0),
+                               np.zeros((1, 4), np.int32))
+        llama_load = _workload(rng, cfg.vocab_size, 4, max_new=(3, 5))
+
+        def _llama(block_size=None):
+            return GenerationEngine.from_model(
+                model, variables, num_slots=2, max_len=64,
+                block_size=block_size, temperature=0.0, min_bucket=8,
+                queue_capacity=64, retries=1)
+
+        shapes["llama"] = lambda: _llama()
+        shapes["llama_paged"] = lambda: _llama(block_size=16)
+
+    for name, mk in shapes.items():
+        load = stub_load if name.startswith("stub") else llama_load
+        out["legs"][name] = fleet_survival_leg(name, mk, load)
+
+    out["legs"]["min_replicas"] = min_replicas_counterfactual_leg()
+    out["legs"]["radix_vs_rr"] = radix_vs_round_robin_leg()
+
+    out["ok"] = (
+        all(v.get("token_identical", True)
+            for v in out["legs"].values())
+        and out["legs"]["min_replicas"]["fails_closed"]
+        and out["legs"]["radix_vs_rr"]["radix_beats_rr"])
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

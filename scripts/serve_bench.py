@@ -1318,6 +1318,139 @@ def run_survivability_comparison(n_requests: int = 24,
     }
 
 
+def run_fleet_comparison(n_requests: int = 24, n_replicas: int = 3,
+                         num_slots: int = 2,
+                         step_s: float = 0.002) -> dict:
+    """The fleet-tier cost model (ISSUE 20), two sub-legs on the stub:
+
+    **Routing** — the SAME prefix-family burst workload through a
+    radix-routed fleet and the round-robin comparator, overloaded
+    (more concurrent clients than fleet slots): fleet-wide prefix
+    reuse/hit-rate and TTFT p99 per policy. Radix must not lose — the
+    co-location win is the whole point of shadow-residency routing.
+
+    **Recovery** — an inline fleet run with one unclean replica kill
+    mid-stream: ``fleet_recovery_s`` is kill-to-first-re-admitted-token
+    (bench_trend auto-gates it lower-is-better) and
+    ``fleet_token_identical`` (float; must stay 1.0) is the
+    zero-dup/zero-loss delivery-cursor + greedy-identity gate against a
+    clean single-engine run."""
+    from sparkdl_tpu.runner import telemetry
+    from sparkdl_tpu.runner.telemetry import histogram_quantile
+    from sparkdl_tpu.serving import (EngineFleet, GenerationEngine,
+                                     StubBackend)
+
+    vocab = 997
+    rng = np.random.RandomState(11)
+    families = [rng.randint(1, vocab, size=48).tolist()
+                for _ in range(n_replicas)]
+    workload = []
+    per_family = max(4, n_requests // len(families))
+    for fi, head in enumerate(families):  # burst arrival per family
+        for i in range(per_family):
+            workload.append((head + [500 + 10 * fi + i], 8))
+
+    def mk():
+        return GenerationEngine(
+            StubBackend(num_slots, 96, vocab_size=vocab, step_s=step_s,
+                        prefix_cache_bytes=1 << 20), retries=1)
+
+    def routing_leg(routing):
+        telemetry.reset()
+        telemetry.start()
+        fleet = EngineFleet([mk() for _ in range(n_replicas)],
+                            routing=routing)
+        done: dict = {}
+        errors: list = []
+
+        def client(idx_chunk):
+            try:
+                for i in idx_chunk:
+                    prompt, new = workload[i]
+                    h = fleet.submit(prompt, max_new_tokens=new)
+                    done[i] = h.result(timeout=120)
+            except Exception as e:  # noqa: BLE001 — recorded below
+                errors.append(f"{type(e).__name__}: {e}")
+
+        concurrency = 2 * n_replicas * num_slots  # genuine overload
+        chunks = [list(range(len(workload)))[i::concurrency]
+                  for i in range(concurrency)]
+        threads = [threading.Thread(target=client, args=(c,),
+                                    daemon=True) for c in chunks if c]
+        fleet.start()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        wall = time.perf_counter() - t0
+        fleet.stop(drain=True, timeout=30)
+        ttft = telemetry.registry().histogram("serving_ttft_s").snapshot()
+        reused = hits = misses = 0
+        for name in fleet.replica_names():
+            ps = fleet.engine(name).backend.prefix_stats() or {}
+            reused += ps.get("reused_tokens", 0)
+            hits += ps.get("hits", 0)
+            misses += ps.get("misses", 0)
+        telemetry.reset()
+        total = sum(len(v) for v in done.values())
+        leg = {"completed": len(done), "tokens": total,
+               "wall_s": round(wall, 4),
+               "tokens_s": round(total / wall, 2) if wall > 0 else None,
+               "ttft_p99_s": histogram_quantile(ttft, 0.99),
+               "reused_tokens": reused,
+               "hit_rate": round(hits / (hits + misses), 4)
+               if hits + misses else None}
+        if errors:
+            leg["errors"] = errors[:5]
+        return leg
+
+    radix = routing_leg("radix")
+    rr = routing_leg("round_robin")
+
+    # recovery sub-leg: inline (deterministic service order → a real
+    # token-identity oracle), one unclean kill mid-stream
+    clean_eng = mk()
+    clean = [clean_eng.submit(p, max_new_tokens=n, block=False)
+             for p, n in workload]
+    clean_eng.run_until_idle()
+
+    fleet = EngineFleet([mk() for _ in range(n_replicas)])
+    t_kill = t_readmit = None
+
+    def cb(fr, tok):
+        nonlocal t_readmit
+        if t_kill is not None and t_readmit is None and fr.hops > 0:
+            t_readmit = time.perf_counter()
+
+    frs = [fleet.submit(p, max_new_tokens=n, stream_cb=cb)
+           for p, n in workload]
+    for _ in range(4):
+        fleet.step()
+    victim = next(fr.replica for fr in frs
+                  if not fr.done and fr.replica is not None)
+    t_kill = time.perf_counter()
+    fleet.kill_replica(victim)
+    fleet.run_until_idle()
+    recovery_s = round(t_readmit - t_kill, 4) if t_readmit else None
+    identical = all(fr.state == "done" and fr.tokens == c.tokens
+                    and fr.delivered == len(fr.tokens)
+                    for fr, c in zip(frs, clean))
+    return {
+        "requests": len(workload), "replicas": n_replicas,
+        "num_slots": num_slots, "step_s": step_s,
+        "radix": radix, "round_robin": rr,
+        "reuse_ratio": round(radix["reused_tokens"]
+                             / rr["reused_tokens"], 4)
+        if rr["reused_tokens"] else None,
+        "readmissions": fleet.stats["readmissions"],
+        # the two bench_trend-gated scalars (float on purpose — the
+        # trend gate skips bools; _s suffix = auto lower-is-better)
+        "recovery_s": recovery_s,
+        "token_identical": 1.0 if identical else 0.0,
+    }
+
+
 def run_stub_scheduler_comparison(n_requests: int = 96,
                                   num_slots: int = 8,
                                   step_s: float = 0.002,
@@ -1382,6 +1515,17 @@ def run(mode: str = "llama", rows: int | None = None) -> dict:
                 n_requests=min(24, max(12, n)))
         except Exception as e:  # noqa: BLE001 — the main legs stand
             rec["survivability_error"] = f"{type(e).__name__}: {e}"[:300]
+    # ISSUE 20 fleet leg: radix-vs-round-robin routing under overload
+    # plus one unclean replica kill with the cross-replica exactly-once
+    # gate — jax-free on the stub, so fleet recovery and routing trends
+    # ride BOTH the healthy llama record and the backend_unavailable
+    # stub record (never-host-blind).
+    if not os.environ.get("BENCH_SKIP_FLEET"):
+        try:
+            rec["fleet"] = run_fleet_comparison(
+                n_requests=min(24, max(12, n)))
+        except Exception as e:  # noqa: BLE001 — the main legs stand
+            rec["fleet_error"] = f"{type(e).__name__}: {e}"[:300]
     # ISSUE 15 paged-kernel leg (real model, llama records only — the
     # stub record's kernel evidence is the churn sub-leg above): two
     # subprocesses pin kernel-on vs gather-view token identity + the

@@ -41,6 +41,12 @@ Sites (where the runner consults the plan):
   routing)
 - ``serve_commit``     — a prefix-cache / radix commit at prefill end
   (commit failures must degrade, never kill the request)
+- ``fleet_route``      — the fleet router's per-request placement decision
+  (``serving/router.py``; the hook's ``step`` is the routing sequence
+  number; ``replica_dead`` here kills the CHOSEN replica uncleanly and
+  exercises shadow-state re-admission)
+- ``fleet_drain``      — entry of a fleet-initiated replica drain
+  (exercises drain-failure → DEAD escalation)
 
 Kinds (what happens when a fault fires):
 
@@ -68,6 +74,11 @@ Kinds (what happens when a fault fires):
   ISSUE 16). A relaunch at a *different* world size is a fresh
   allocation and the marker does not apply; deleting the marker models
   recovered capacity (the grow-back probe then succeeds).
+- ``replica_dead`` — raise an ``InjectedReplicaDead``: a whole serving
+  replica is gone, UNCLEANLY — no drain, no snapshots, its engine
+  unusable. Fleet sites only. The router (the only layer that can
+  survive this) must fall back to its shadow state to re-admit the
+  replica's in-flight requests elsewhere (ISSUE 20).
 - ``cache_lost`` — raise a serving-fatal ``InjectedCacheLost`` shaped like
   the donated-slot-cache loss ``serving/backend.py`` converts real jit
   failures into (``SlotCacheLost``): the slot KV cache is gone, retrying
@@ -102,7 +113,8 @@ import sys
 import time
 
 __all__ = ["Fault", "FaultPlan", "InjectedFault", "InjectedPreemption",
-           "InjectedFatal", "InjectedCacheLost", "SITES", "SERVING_SITES",
+           "InjectedFatal", "InjectedCacheLost", "InjectedReplicaDead",
+           "SITES", "SERVING_SITES", "FLEET_SITES",
            "KINDS", "CHAOS_ENV",
            "fire", "install", "uninstall", "active_plan",
            "corrupt_latest_checkpoint"]
@@ -111,11 +123,12 @@ CHAOS_ENV = "SPARKDL_CHAOS"
 
 SERVING_SITES = ("serve_prefill", "serve_decode", "serve_alloc",
                  "serve_commit")
+FLEET_SITES = ("fleet_route", "fleet_drain")
 SITES = ("step_start", "checkpoint_save", "batch_fetch", "collective",
          "worker", "decode", "dispatch", "checkpoint_restore",
-         "data_fetch") + SERVING_SITES
+         "data_fetch") + SERVING_SITES + FLEET_SITES
 KINDS = ("preempt", "fatal", "nan", "hang", "sigkill", "corrupt", "poison",
-         "decimate", "cache_lost")
+         "decimate", "cache_lost", "replica_dead")
 
 
 class InjectedFault(RuntimeError):
@@ -141,6 +154,14 @@ class InjectedCacheLost(InjectedFault):
     module stays jax-free; the engine routes on the ``serving_fatal``
     class attribute, exactly as it does for the organic error."""
     serving_fatal = True
+
+
+class InjectedReplicaDead(InjectedFault):
+    """A whole serving replica died UNCLEANLY (ISSUE 20): no drain, no
+    snapshots, engine unusable. Retryable AT THE FLEET TIER only — the
+    router re-admits the replica's in-flight requests from its shadow
+    state on the survivors; nothing below the router can recover from
+    this."""
 
 
 # The one announcement string for DELIBERATE fault injection in
@@ -213,6 +234,10 @@ class Fault:
             raise ValueError("kind='cache_lost' models a donated slot-"
                              "cache loss — use a serving site: "
                              f"{SERVING_SITES}")
+        if self.kind == "replica_dead" and self.site not in FLEET_SITES:
+            raise ValueError("kind='replica_dead' kills a whole serving "
+                             "replica — only the fleet router can "
+                             f"survive it; use a fleet site: {FLEET_SITES}")
         if self.at_step is None and not (0.0 < self.prob <= 1.0):
             raise ValueError(f"fault needs a trigger: at_step=N or "
                              f"0 < prob <= 1 (got at_step=None, "
@@ -406,6 +431,11 @@ def _execute(f: Fault, site: str, step, batch, path: str | None = None):
             f"injected slot-cache loss ({where}): donated KV cache "
             "consumed by a failed dispatch; backend state unrecoverable "
             "— engine must fail over")
+    if f.kind == "replica_dead":
+        raise InjectedReplicaDead(
+            f"injected replica death ({where}): the replica is gone "
+            "uncleanly — no drain possible; the fleet router must "
+            "re-admit its in-flight requests from shadow state")
     if f.kind == "nan":
         return _poison(batch)
     if f.kind == "poison":

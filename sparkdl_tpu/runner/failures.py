@@ -185,6 +185,17 @@ SERVING_CLASS_VERDICTS = {
     # chaos's serving-fatal stand-in (runner/chaos.py) rides the same
     # lost-backend-state verdict as the organic SlotCacheLost
     "InjectedCacheLost": "retryable",
+    # Fleet tier (ISSUE 20). A stale/foreign resume snapshot is the
+    # caller's bug (re-sending it re-fails); a sub-floor fleet or a shed
+    # is capacity that can come back; a universal-rejection routing
+    # error reproduces on retry by construction. An injected unclean
+    # replica death is retryable AT THE FLEET TIER — the router
+    # re-admits from shadow state.
+    "SnapshotIncompatibleError": "fatal",
+    "FleetDegradedError": "retryable",
+    "RequestShedError": "retryable",
+    "FleetRoutingError": "fatal",
+    "InjectedReplicaDead": "retryable",
 }
 
 

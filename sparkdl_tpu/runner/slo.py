@@ -54,7 +54,8 @@ from .telemetry import histogram_fraction_below
 __all__ = [
     "SLO_TTFT_ENV", "SLO_LATENCY_ENV", "SLO_ERROR_RATE_ENV",
     "SLO_TARGET_ENV", "SLO_WINDOWS_ENV", "SLO_BURN_ENV",
-    "Objective", "SloMonitor", "objectives_from_env", "from_env",
+    "Objective", "SloMonitor", "ReplicaBurnTracker",
+    "objectives_from_env", "from_env",
     "monitor", "evaluate", "enabled", "reset", "compliance_from_traces",
 ]
 
@@ -328,6 +329,79 @@ class SloMonitor:
                             ob.get("burn_rate"))
         except Exception:  # noqa: BLE001 — gauges are best-effort
             pass
+
+
+class ReplicaBurnTracker:
+    """Per-REPLICA burn rates for the fleet router (ISSUE 20): the
+    process-global :class:`SloMonitor` evaluates ONE engine's cumulative
+    telemetry, but replica health needs burn attributed to each replica
+    separately — so the router feeds this tracker raw per-request
+    samples (TTFT, latency, outcome) as it observes them and reads back
+    windowed burn rates against the SAME ``SPARKDL_SLO_*`` objectives
+    (:func:`objectives_from_env`). Single short window by design: the
+    router reacts to *current* replica pain (a DEGRADED verdict is
+    reversible), so the multi-window "sustained" gate that guards
+    paging humans would only slow it down. No objectives armed = every
+    read returns None and health falls back to the failover/heartbeat
+    signals alone."""
+
+    def __init__(self, objectives=None, window_s: float = 30.0):
+        self.objectives = objectives_from_env() if objectives is None \
+            else list(objectives)
+        self.window_s = max(1.0, float(window_s))
+        # (t, kind, value): kind "ttft"/"latency" carry seconds, kind
+        # "outcome" carries 1.0 for an error, 0.0 for a completion
+        self._samples: collections.deque = collections.deque()
+        self._lock = threading.Lock()
+
+    def record_ttft(self, seconds: float, now: float | None = None):
+        self._record("ttft", float(seconds), now)
+
+    def record_latency(self, seconds: float, now: float | None = None):
+        self._record("latency", float(seconds), now)
+
+    def record_outcome(self, ok: bool, now: float | None = None):
+        self._record("outcome", 0.0 if ok else 1.0, now)
+
+    def _record(self, kind: str, value: float, now: float | None):
+        now = time.time() if now is None else now
+        with self._lock:
+            self._samples.append((now, kind, value))
+            self._trim(now)
+
+    def _trim(self, now: float):
+        horizon = now - self.window_s
+        while self._samples and self._samples[0][0] < horizon:
+            self._samples.popleft()
+
+    def burn_rates(self, now: float | None = None) -> dict:
+        """``{objective name: burn rate | None}`` over the window
+        (None = no samples for that objective yet)."""
+        now = time.time() if now is None else now
+        with self._lock:
+            self._trim(now)
+            samples = list(self._samples)
+        out: dict = {}
+        for obj in self.objectives:
+            if obj.kind == "histogram":
+                kind = "ttft" if obj.name == "ttft" else "latency"
+                vals = [v for _, k, v in samples if k == kind]
+                compliance = (sum(1 for v in vals if v <= obj.threshold)
+                              / len(vals)) if vals else None
+            else:
+                vals = [v for _, k, v in samples if k == "outcome"]
+                compliance = (1.0 - sum(vals) / len(vals)) if vals \
+                    else None
+            budget = 1.0 - obj.target
+            out[obj.name] = None if compliance is None or budget <= 0 \
+                else round((1.0 - compliance) / budget, 4)
+        return out
+
+    def max_burn(self, now: float | None = None) -> float | None:
+        """The worst objective's burn (the router's one-number health
+        input); None when no objective has data (or none armed)."""
+        burns = [b for b in self.burn_rates(now).values() if b is not None]
+        return max(burns) if burns else None
 
 
 # ---------------------------------------------------------------------------

@@ -58,7 +58,8 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "StageAccountant",
     "RequestTraceCollector", "assemble_request_traces", "request_traces",
     "start", "stop", "enabled", "maybe_start_from_env", "registry",
-    "accountant", "snapshot", "flush_snapshot", "render_prometheus",
+    "accountant", "fleet_metric",
+    "snapshot", "flush_snapshot", "render_prometheus",
     "aggregate_snapshots", "clear_rank_files", "stage_utilization_summary",
     "server_port", "histogram_quantile", "histogram_fraction_below",
 ]
@@ -932,6 +933,29 @@ def registry() -> MetricsRegistry:
 
 def accountant() -> StageAccountant:
     return _get_plane().accountant
+
+
+def fleet_metric(event: str, value: float = 1.0):
+    """Fleet-tier metric exports (ISSUE 20), registered HERE with
+    literal names so ``scripts/check_metric_docs.py`` sees every fleet
+    metric at one grep-able site. ``event``: ``"healthy"`` sets the
+    ``fleet_replicas_healthy`` gauge to ``value``; the counter events
+    (``hedge_fired`` / ``hedge_won`` / ``readmitted`` / ``shed``)
+    increment by ``value``. No-op while the plane is off — the same
+    zero-overhead contract as the engine's ``_metric`` helper."""
+    if not enabled():
+        return
+    reg = registry()
+    if event == "healthy":
+        reg.gauge("fleet_replicas_healthy").set(value)
+    elif event == "hedge_fired":
+        reg.counter("fleet_hedges_fired_total").inc(value)
+    elif event == "hedge_won":
+        reg.counter("fleet_hedges_won_total").inc(value)
+    elif event == "readmitted":
+        reg.counter("fleet_readmissions_total").inc(value)
+    elif event == "shed":
+        reg.counter("fleet_requests_shed_total").inc(value)
 
 
 def request_traces() -> RequestTraceCollector:

@@ -295,6 +295,19 @@ class DeadlineExceeded(ServingError):
     ``SPARKDL_SERVE_DEADLINE_S`` default) passed before completion."""
 
 
+class SnapshotIncompatibleError(ServingError):
+    """A resume snapshot failed validation (unknown version, missing
+    fields, or an inconsistent delivery cursor) — rejected BEFORE it
+    can corrupt a slot. Fatal by taxonomy: replaying it elsewhere
+    reproduces the same rejection."""
+
+
+# Version tag on resume snapshots (ISSUE 20): bump when the snapshot
+# shape changes so a stale/foreign snapshot raises
+# :class:`SnapshotIncompatibleError` instead of corrupting a slot.
+SNAPSHOT_VERSION = 1
+
+
 def bucket_length(prompt_len: int, min_bucket: int = _DEFAULT_MIN_BUCKET
                   ) -> int:
     """Prefill bucket for a prompt: the next power of two >=
@@ -448,6 +461,23 @@ class Request:
 
     def wait(self, timeout: float | None = None) -> bool:
         return self._done.wait(timeout)
+
+    def snapshot(self) -> dict:
+        """Self-contained, version-tagged resume state (ISSUE 20):
+        everything a DIFFERENT engine needs to continue this request —
+        prompt, emitted tokens, the exactly-once delivery cursor, and
+        the generation params. Plain ints/lists, so it survives a
+        process hop (a router's shadow state for an uncleanly dead
+        replica is exactly this dict rebuilt host-side)."""
+        return {
+            "version": SNAPSHOT_VERSION,
+            "id": self.id,
+            "prompt": list(self.prompt),
+            "tokens": list(self.tokens),
+            "delivered": self.delivered,
+            "max_new_tokens": self.max_new_tokens,
+            "failovers": self.failovers,
+        }
 
     def result(self, timeout: float | None = None) -> list[int]:
         """Generated token ids (prompt excluded). Raises the request's
@@ -827,6 +857,10 @@ class GenerationEngine:
         # the note the fail-closed EngineStopped carries, and the
         # operator-facing ledger introspect/snapshot expose.
         self._failing_over = False
+        # Router-side liveness (ISSUE 20): stamped at every iteration
+        # (and every idle wait) — a fleet router reads this to tell a
+        # busy-but-advancing replica from a wedged one.
+        self.t_heartbeat = time.time()
         self._failover_streak = 0
         self._tokens_at_failover = -1
         self._backend_calls = 0
@@ -1172,6 +1206,7 @@ class GenerationEngine:
         worked=True and serving continues."""
         if self._fatal is not None:
             raise EngineStopped("engine died") from self._fatal
+        self.t_heartbeat = time.time()
         try:
             return self._step_inner()
         except Exception as e:  # noqa: BLE001 — failover routing
@@ -1381,14 +1416,35 @@ class GenerationEngine:
             pool.shutdown(wait=False)
         return snaps
 
-    def resume(self, req: Request) -> Request:
-        """Re-admit a drained/preempted snapshot (from :meth:`drain` or
-        a degraded stop) — on this engine or a fresh one. The request
-        keeps its handle and id; its prefill consumes
-        ``prompt + tokens-so-far`` and the stream continues exactly
-        where it left off (greedy determinism), nothing re-emitted."""
+    def resume(self, req: "Request | dict", *, stream_cb=None) -> Request:
+        """Re-admit a drained/preempted snapshot — on this engine or a
+        DIFFERENT one (ISSUE 20). Accepts either the :class:`Request`
+        handle :meth:`drain` returned, or a self-contained snapshot
+        dict from :meth:`Request.snapshot` (the router's shadow-state
+        path for an uncleanly dead replica; ``stream_cb`` attaches the
+        continuation stream). The request keeps its id; its prefill
+        consumes ``prompt + tokens-so-far`` and the stream continues
+        exactly where it left off (greedy determinism), nothing
+        re-emitted.
+
+        Cross-engine safety: the request is RE-BUCKETED for THIS
+        engine's config (chunk alignment / ``min_bucket`` may differ
+        from the engine that drained it); a snapshot that cannot fit
+        this engine's ``max_len`` raises :class:`RequestRejected`, and
+        a stale/foreign snapshot (unknown version, missing fields, or
+        a delivery cursor past the emitted tokens) raises
+        :class:`SnapshotIncompatibleError` — both BEFORE the snapshot
+        can touch a slot. Undelivered tail tokens (emitted but never
+        streamed before the hop) are dropped back to the delivery
+        cursor: greedy determinism regenerates them identically, so
+        the client stream stays zero-dup / zero-loss."""
+        if isinstance(req, dict):
+            req = self._request_from_snapshot(req, stream_cb)
+        elif stream_cb is not None:
+            req.stream_cb = stream_cb
         if req.state in (DONE, FAILED):
             return req
+        req.bucket = self._resume_bucket(req)
         with self._work:
             if self._stop_mode is not None or self._fatal is not None:
                 raise EngineStopped("engine is stopped")
@@ -1401,6 +1457,102 @@ class GenerationEngine:
             self.stats["submitted"] += 1
             self._work.notify_all()
         return req
+
+    def _request_from_snapshot(self, snap: dict, stream_cb) -> Request:
+        """Rehydrate a :meth:`Request.snapshot` dict into a fresh
+        handle (validation first — a foreign/corrupt snapshot must die
+        here, not in a slot)."""
+        version = snap.get("version")
+        if version != SNAPSHOT_VERSION:
+            raise SnapshotIncompatibleError(
+                f"resume snapshot version {version!r} is not the "
+                f"supported version {SNAPSHOT_VERSION}")
+        try:
+            rid = int(snap["id"])
+            prompt = [int(t) for t in snap["prompt"]]
+            tokens = [int(t) for t in snap["tokens"]]
+            delivered = int(snap["delivered"])
+            max_new = int(snap["max_new_tokens"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise SnapshotIncompatibleError(
+                f"resume snapshot is missing or malforms a required "
+                f"field: {e!r}") from e
+        if not prompt:
+            raise SnapshotIncompatibleError(
+                "resume snapshot has an empty prompt")
+        if delivered < 0 or delivered > len(tokens):
+            raise SnapshotIncompatibleError(
+                f"resume snapshot delivery cursor {delivered} is "
+                f"outside its emitted tokens [0, {len(tokens)}] — "
+                f"re-admitting it could duplicate or lose streamed "
+                f"tokens")
+        req = Request(rid, prompt, max_new, 0, stream_cb)
+        # Roll emitted-but-undelivered tokens back to the cursor: the
+        # client never saw them, and the greedy continuation regrows
+        # them bit-identically.
+        req.tokens = tokens[:delivered]
+        req.delivered = delivered
+        req.failovers = int(snap.get("failovers", 0) or 0)
+        return req
+
+    def _resume_bucket(self, req: Request) -> int:
+        """Re-bucket a resumed request for THIS engine (its stored
+        bucket belongs to the engine that drained it). Same fit rules
+        as :meth:`submit`, over the SERVED sequence (prompt + tokens
+        already generated)."""
+        served = len(req.prompt) + len(req.tokens)
+        remaining = max(1, req.max_new_tokens - len(req.tokens))
+        if self.stall_free:
+            c = self.prefill_chunk
+            bucket = -(-served // c) * c
+            if max(bucket, served + remaining) > self.backend.max_len:
+                self._reject(
+                    f"resumed request {req.id}: chunk-aligned served "
+                    f"length ({bucket}) + remaining tokens "
+                    f"({remaining}) exceeds max_len "
+                    f"{self.backend.max_len}")
+        else:
+            bucket = bucket_length(served, self.min_bucket)
+            if bucket + remaining > self.backend.max_len:
+                self._reject(
+                    f"resumed request {req.id}: bucketed served length "
+                    f"({bucket}) + remaining tokens ({remaining}) "
+                    f"exceeds max_len {self.backend.max_len}")
+        if self.paged:
+            # Never-fit only — a coverable-but-currently-full pool
+            # waits FIFO (the admission gate's backpressure), exactly
+            # the submit() posture.
+            bs = self.backend.block_size
+            rows = served + remaining if self.stall_free \
+                else max(bucket, served + remaining)
+            need = min(-(-rows // bs) + 1,
+                       -(-self.backend.max_len // bs))
+            total = self.backend.allocator.usable_blocks
+            if need > total:
+                self._reject(
+                    f"resumed request {req.id} needs {need} KV blocks "
+                    f"(block_size {bs}); the whole pool holds {total} "
+                    f"— can never fit")
+        return bucket
+
+    def residency_digest(self) -> dict | None:
+        """Compact digest of the backend's resident prefix heads
+        (ISSUE 20) — what a fleet router's radix-aware placement
+        shadows. Duck-typed over both cache families: the paged
+        backends' :class:`~sparkdl_tpu.serving.prefix.RadixPrefixCache`
+        (via ``backend.radix`` / ``backend.mgr.radix``) or the unpaged
+        byte-payload LRU (``backend.prefix_cache``). ``None`` when no
+        prefix cache is enabled."""
+        be = self.backend
+        radix = getattr(be, "radix", None)
+        if radix is None:
+            radix = getattr(getattr(be, "mgr", None), "radix", None)
+        if radix is not None:
+            return radix.residency_digest()
+        pc = getattr(be, "prefix_cache", None)
+        if pc is not None:
+            return pc.residency_digest()
+        return None
 
     def __enter__(self):
         return self.start()
@@ -1424,6 +1576,7 @@ class GenerationEngine:
                     if idle:
                         if self._stop_mode == "drain":
                             break
+                        self.t_heartbeat = time.time()
                         self._work.wait(0.05)
                         continue
                 try:

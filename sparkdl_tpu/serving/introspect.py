@@ -25,9 +25,11 @@ import time
 import weakref
 
 __all__ = ["register_engine", "live_engines", "engine_debug_state",
+           "register_fleet", "live_fleets", "fleet_debug_state",
            "serving_snapshot"]
 
 _ENGINES: "weakref.WeakSet" = weakref.WeakSet()
+_FLEETS: "weakref.WeakSet" = weakref.WeakSet()
 _lock = threading.Lock()
 
 
@@ -38,9 +40,32 @@ def register_engine(engine) -> None:
         _ENGINES.add(engine)
 
 
+def register_fleet(fleet) -> None:
+    """Track a live :class:`~sparkdl_tpu.serving.router.EngineFleet`
+    for the ``/serving`` inspector (weakly, like engines)."""
+    with _lock:
+        _FLEETS.add(fleet)
+
+
 def live_engines() -> list:
     with _lock:
         return list(_ENGINES)
+
+
+def live_fleets() -> list:
+    with _lock:
+        return list(_FLEETS)
+
+
+def fleet_debug_state(fleet) -> dict:
+    """One fleet's router-tier state (ISSUE 20): per-replica health +
+    reason, routing load, residency-shadow size, burn, breaker ledger,
+    plus the fleet counters (hedges fired/won, re-admissions, sheds,
+    replica deaths). Pure delegation — the router already exposes a
+    JSON-able ``debug_state()``."""
+    out = fleet.debug_state()
+    out["t"] = round(time.time(), 6)
+    return out
 
 
 def engine_debug_state(eng) -> dict:
@@ -158,5 +183,15 @@ def serving_snapshot() -> dict:
         except Exception as e:  # noqa: BLE001 — inspector must degrade
             engines.append({"error": f"{type(e).__name__}: {e}"[:300]})
     engines.sort(key=lambda d: d.get("t", 0))
-    return {"t": round(time.time(), 6), "n_engines": len(engines),
-            "engines": engines}
+    fleets = []
+    for fleet in live_fleets():
+        try:
+            fleets.append(fleet_debug_state(fleet))
+        except Exception as e:  # noqa: BLE001 — inspector must degrade
+            fleets.append({"error": f"{type(e).__name__}: {e}"[:300]})
+    out = {"t": round(time.time(), 6), "n_engines": len(engines),
+           "engines": engines}
+    if fleets:
+        out["n_fleets"] = len(fleets)
+        out["fleets"] = fleets
+    return out

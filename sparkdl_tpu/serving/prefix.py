@@ -48,13 +48,43 @@ import collections
 import itertools
 import os
 import threading
+import zlib
 
 __all__ = ["PrefixCache", "RadixPrefixCache", "PREFIX_CACHE_MB_ENV",
            "DEFAULT_PREFIX_CACHE_MB", "prefix_cache_budget_bytes",
-           "usable_reuse"]
+           "usable_reuse", "prompt_digest_chain", "DIGEST_GRANULE"]
 
 PREFIX_CACHE_MB_ENV = "SPARKDL_SERVE_PREFIX_CACHE_MB"
 DEFAULT_PREFIX_CACHE_MB = 64.0
+
+# Granule for the unpaged cache's residency digest (the radix cache
+# digests at its block_size — the natural sharing unit it already has).
+DIGEST_GRANULE = 16
+
+
+def _run_hash(run, seed: int) -> int:
+    """Chain-hash one granule run of token ids onto ``seed``. crc32 over
+    a separator-joined id encoding: deterministic across processes (no
+    PYTHONHASHSEED salt), collision-tolerant by design — the digest is a
+    routing HINT; a false positive costs one suboptimal placement, never
+    correctness (the engine's own caches compare token-by-token)."""
+    return zlib.crc32(b"\x00".join(str(t).encode() for t in run),
+                      seed) & 0xFFFFFFFF
+
+
+def prompt_digest_chain(prompt, granule: int) -> list[tuple[int, int]]:
+    """``(head_tokens, chained_hash)`` for every granule-aligned head of
+    ``prompt`` — THE chaining both caches' :meth:`residency_digest` use,
+    so a router can hash an incoming prompt once and intersect with each
+    replica's digest set to find its deepest resident head."""
+    granule = max(1, int(granule))
+    prompt = tuple(prompt)
+    out: list[tuple[int, int]] = []
+    h = 0
+    for i in range(0, (len(prompt) // granule) * granule, granule):
+        h = _run_hash(prompt[i:i + granule], h)
+        out.append((i + granule, h))
+    return out
 
 
 def prefix_cache_budget_bytes() -> int:
@@ -181,6 +211,21 @@ class PrefixCache:
         with self._lock:
             self._entries.clear()
             self.bytes = 0
+
+    def residency_digest(self, granule: int = DIGEST_GRANULE) -> dict:
+        """Compact picture of what prefix heads are resident:
+        ``{"granule": g, "heads": {chained_hash: head_tokens}}`` over
+        every cached entry's granule-aligned heads (see
+        :func:`prompt_digest_chain`). Keys are snapshotted under the
+        lock; hashing runs outside it (tuples are immutable)."""
+        with self._lock:
+            keys = list(self._entries)
+        heads: dict[int, int] = {}
+        for key in keys:
+            for n, h in prompt_digest_chain(key, granule):
+                if heads.get(h, 0) < n:
+                    heads[h] = n
+        return {"granule": max(1, int(granule)), "heads": heads}
 
     def stats(self) -> dict:
         with self._lock:
@@ -363,6 +408,24 @@ class RadixPrefixCache:
                 self.allocator.deref(node.block)
             self._root = _RadixNode()
             self._n_blocks = 0
+
+    def residency_digest(self) -> dict:
+        """Same shape as :meth:`PrefixCache.residency_digest`, granule
+        fixed at ``block_size`` (the trie's edge unit): one chained hash
+        per trie node, accumulated root→leaf, so every cached prefix
+        head maps to exactly one digest entry."""
+        heads: dict[int, int] = {}
+        with self._lock:
+            stack: list[tuple[_RadixNode, int, int]] = [(self._root, 0, 0)]
+            while stack:
+                node, h, depth = stack.pop()
+                for run, child in node.children.items():
+                    ch = _run_hash(run, h)
+                    n = (depth + 1) * self.block_size
+                    if heads.get(ch, 0) < n:
+                        heads[ch] = n
+                    stack.append((child, ch, depth + 1))
+        return {"granule": self.block_size, "heads": heads}
 
     def stats(self) -> dict:
         with self._lock:

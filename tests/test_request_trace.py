@@ -667,6 +667,52 @@ class TestReportClis:
         assert {"serve_recovery_s", "serve_failover_token_identical"} \
             <= set(rep["regressions"])
 
+    def test_serve_bench_fleet_leg_and_gating(self):
+        """ISSUE 20 satellite: the fleet leg reports the radix-vs-
+        round-robin routing comparison plus one unclean replica kill's
+        recovery latency and the cross-replica exactly-once float;
+        _serve_headline forwards them (riding healthy AND
+        backend_unavailable records) and bench_trend's name-shape rules
+        gate fleet_recovery_s lower-is-better and fleet_token_identical
+        higher-is-better."""
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "serve_bench",
+            os.path.join(_REPO, "scripts", "serve_bench.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        flt = mod.run_fleet_comparison(n_requests=12, step_s=0.001)
+        assert flt["token_identical"] == 1.0  # float, NOT bool
+        assert not isinstance(flt["token_identical"], bool)
+        assert flt["recovery_s"] is not None and flt["recovery_s"] > 0
+        assert flt["readmissions"] >= 1
+        for leg in (flt["radix"], flt["round_robin"]):
+            assert leg["completed"] == flt["requests"]
+            assert leg["reused_tokens"] >= 0
+        sys.path.insert(0, _REPO)
+        import bench
+        head = bench._serve_headline({"fleet": flt})
+        assert head["fleet_recovery_s"] == flt["recovery_s"]
+        assert head["fleet_token_identical"] == 1.0
+        assert head["fleet_prefix_reuse_ratio"] == flt["reuse_ratio"]
+        bt_spec = importlib.util.spec_from_file_location(
+            "bench_trend",
+            os.path.join(_REPO, "scripts", "bench_trend.py"))
+        bt = importlib.util.module_from_spec(bt_spec)
+        bt_spec.loader.exec_module(bt)
+        assert bt._LOWER_IS_BETTER.search("fleet_recovery_s")
+        assert not bt._LOWER_IS_BETTER.search("fleet_token_identical")
+        # slower fleet recovery OR a broken identity trips the gate
+        recs = [{"n": i, "parsed": {"metric": "m", "value": 1.0,
+                                    "extra": e}}
+                for i, e in ((1, {"fleet_recovery_s": 0.05,
+                                  "fleet_token_identical": 1.0}),
+                             (2, {"fleet_recovery_s": 0.12,
+                                  "fleet_token_identical": 0.0}))]
+        rep = bt.trend(recs)
+        assert {"fleet_recovery_s", "fleet_token_identical"} \
+            <= set(rep["regressions"])
+
     def test_gang_aggregation_merges_trace_blocks(self, tmp_path):
         """aggregate_snapshots re-ranks the per-rank slowest lists into
         one gang tail."""

@@ -1362,3 +1362,23 @@ def test_serve_chaos_smoke_end_to_end():
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     assert mod.main() == 0
+
+
+@pytest.mark.slow
+def test_fleet_chaos_smoke_end_to_end():
+    """ISSUE 20 fleet evidence: a ≥3-replica fleet surviving one
+    injected unclean replica_dead AND one DOOMED drain-and-re-admit
+    per backend shape (Stub/Llama x unpaged/paged), token-identical to
+    a clean single-engine run with a zero-dup/zero-loss delivery-cursor
+    audit; the SPARKDL_FLEET_MIN_REPLICAS counterfactual failing closed
+    classified; and the radix-aware router beating round-robin on
+    fleet-wide prefix reuse (scripts/fleet_chaos_smoke.py,
+    in-process)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "fleet_chaos_smoke", os.path.join(
+            os.path.dirname(__file__), "..", "scripts",
+            "fleet_chaos_smoke.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main() == 0
